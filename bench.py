@@ -1,0 +1,77 @@
+"""Headline benchmark: sim-years/sec/chip on the reference's default config.
+
+Config matches the reference driver (main.cpp:7-10,44-65): 9-miner 2025
+hashrate distribution, 1 s propagation, honest-only, 365.2425-day runs. The
+baseline is the measured C++ reference throughput of ~86 sim-years/sec on one
+CPU core (BASELINE.md:20); vs_baseline is the speedup over that.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+CPU_CORE_BASELINE_SIM_YEARS_PER_S = 86.0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=0, help="runs per jitted batch (0 = auto)")
+    ap.add_argument("--target-seconds", type=float, default=30.0, help="measurement budget")
+    ap.add_argument("--max-batches", type=int, default=64)
+    args = ap.parse_args()
+
+    import jax
+
+    from tpusim import SimConfig, default_network, DEFAULT_DURATION_MS
+    from tpusim.engine import make_batch_fn
+    from tpusim.runner import make_run_keys
+
+    platform = jax.devices()[0].platform
+    batch = args.batch_size or (4096 if platform != "cpu" else 256)
+
+    config = SimConfig(
+        network=default_network(propagation_ms=1000),
+        duration_ms=DEFAULT_DURATION_MS,
+        runs=batch,
+        batch_size=batch,
+        seed=7,
+    )
+    _, batch_fn = make_batch_fn(config)
+    years_per_run = config.duration_ms / (365.2425 * 86_400_000.0)
+
+    # Compile + warm up (first TPU compile is slow and must not be timed).
+    warm = batch_fn(make_run_keys(config.seed, 0, batch))
+    jax.block_until_ready(warm)
+
+    total_runs = 0
+    t0 = time.perf_counter()
+    for i in range(args.max_batches):
+        out = batch_fn(make_run_keys(config.seed, (i + 1) * batch, batch))
+        jax.block_until_ready(out)
+        total_runs += batch
+        if time.perf_counter() - t0 >= args.target_seconds:
+            break
+    elapsed = time.perf_counter() - t0
+
+    sim_years_per_s = total_runs * years_per_run / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": f"sim_years_per_sec_per_chip ({platform}, {total_runs} runs x 365d, 9-miner honest)",
+                "value": round(sim_years_per_s, 3),
+                "unit": "sim-years/s/chip",
+                "vs_baseline": round(sim_years_per_s / CPU_CORE_BASELINE_SIM_YEARS_PER_S, 3),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

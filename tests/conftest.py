@@ -1,0 +1,23 @@
+"""Test environment: force CPU with 8 virtual devices so multi-chip sharding
+paths are exercised without TPU hardware (the driver validates the real
+multi-chip path separately via __graft_entry__.dryrun_multichip).
+
+This container's sitecustomize imports jax and registers a remote TPU PJRT
+plugin at interpreter startup, so env vars alone are too late — use
+jax.config.update before any backend is initialized. Eager per-op dispatch
+through the remote TPU tunnel is also catastrophically slow, which is its own
+reason tests must run on local CPU.
+"""
+
+import os
+
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,193 @@
+"""Port of the reference's gamma=0 selfish-strategy state-machine suite.
+
+Every case of ``TestSelfishStrategy`` (reference test.cpp:210-367) — the 2013
+paper's section 4.2 states a, b, d-h plus the reference's two extra scenarios —
+is reproduced as an exact-state test of the vectorized automaton: the initial
+chains are converted to automaton state, one FoundBlock/NotifyBestChain event
+is applied through the real kernels, and the result is asserted equal to the
+expected chains, block for block (case c is unreachable at gamma=0,
+test.cpp:249-250).
+
+Miner 0 is the selfish miner (35% hashrate, 100ms propagation, matching
+test.cpp:216-217); miner 1 stands for the rest of the network.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusim.config import MinerConfig, NetworkConfig, SimConfig
+from tpusim.state import I32, I64, found_block, make_params, notify
+from tpusim.testing import assert_state_matches_chains, state_from_chains
+
+S = 0  # selfish miner id
+O = 1  # "others" id
+PROP = 100  # SM_PROP_TIME, test.cpp:216
+SEC = 1000
+
+
+def sec(x: float) -> int:
+    return int(x * SEC)
+
+
+@pytest.fixture
+def config() -> SimConfig:
+    return SimConfig(
+        network=NetworkConfig(
+            miners=(
+                MinerConfig(hashrate_pct=35, propagation_ms=PROP, selfish=True),
+                MinerConfig(hashrate_pct=65, propagation_ms=1000),
+            )
+        ),
+        duration_ms=10_000_000,
+        runs=1,
+        mode="exact",
+    )
+
+
+def apply_found(config, chains, t, best_len_with_genesis, winner=S):
+    """FoundBlock on the automaton; best_len_with_genesis mirrors the
+    reference's chain.size()-convention argument (test.cpp:226,232,242)."""
+    state = state_from_chains(chains, t, config, best_height_prev=best_len_with_genesis - 1)
+    state = state._replace(t=jnp.asarray(t, I64))
+    return found_block(state, make_params(config), jnp.asarray(winner, I32))
+
+
+def apply_notify(config, chains, t):
+    state = state_from_chains(chains, t, config)
+    state = state._replace(t=jnp.asarray(t, I64))
+    return notify(state, make_params(config))
+
+
+def test_case_a_pool_finds_block_extends_private_branch(config):
+    """test.cpp:219-235: any state but a 1-block race — appending stays private."""
+    sm = [(O, sec(600)), (S, sec(1200))]
+    others = [(O, sec(600)), (S, sec(1200))]
+
+    # Private fork of 0 blocks: pool appends one private block.
+    state = apply_found(config, [sm, others], sec(1800), best_len_with_genesis=3)
+    sm_after = sm + [(S, None)]
+    assert_state_matches_chains(state, [sm_after, others], sec(1800), config)
+
+    # Private chain of 1 block: the lead grows by one more private block.
+    state = apply_found(config, [sm_after, others], sec(2400), best_len_with_genesis=3)
+    assert_state_matches_chains(state, [sm_after + [(S, None)], others], sec(2400), config)
+
+
+def test_case_b_one_block_race_pool_wins_publishes_both(config):
+    """test.cpp:237-247: two branches of length 1, pool finds a block —
+    it publishes its secret branch of length two."""
+    sm = [(O, sec(600)), (S, sec(1200)), (O, sec(1800)), (S, None)]
+    others = [(O, sec(600)), (S, sec(1200)), (O, sec(1800)), (O, sec(2400))]
+    state = apply_found(config, [sm, others], sec(3600), best_len_with_genesis=5)
+    sm_after = [
+        (O, sec(600)),
+        (S, sec(1200)),
+        (O, sec(1800)),
+        (S, sec(3600) + PROP),
+        (S, sec(3600) + PROP),
+    ]
+    assert_state_matches_chains(state, [sm_after, others], sec(3600), config)
+    assert int(state.n_private[S]) == 0
+
+
+def test_case_d_race_others_extend_their_head(config):
+    """test.cpp:252-260: others find a block on their own head during the race;
+    the pool switches to the longer chain, its private block goes stale."""
+    sm = [(O, sec(600)), (S, sec(1200)), (O, sec(1800)), (S, None)]
+    best = [(O, sec(600)), (S, sec(1200)), (O, sec(1800)), (O, sec(2400)), (O, sec(3000))]
+    state = apply_notify(config, [sm, best], sec(3000))
+    assert_state_matches_chains(state, [best, best], sec(3000), config)
+    assert np.asarray(state.stale).tolist() == [1, 0]
+
+
+def test_case_e_no_private_branch_others_find_block(config):
+    """test.cpp:262-271: nothing private; the pool simply adopts, no stale."""
+    sm = [(O, sec(600)), (S, sec(1200)), (O, sec(1800)), (S, sec(2400))]
+    best = sm + [(O, sec(3000))]
+    state = apply_notify(config, [sm, best], sec(3000))
+    assert_state_matches_chains(state, [best, best], sec(3000), config)
+    assert np.asarray(state.stale).tolist() == [0, 0]
+
+
+def test_case_f_lead_was_1_others_catch_up_reveal_single(config):
+    """test.cpp:273-283: lead 1 and others catch up — the pool publishes its
+    single secret block and keeps mining on it."""
+    sm = [(O, sec(600)), (S, sec(1200)), (S, None)]
+    others = [(O, sec(600)), (S, sec(1200)), (O, sec(1800))]
+    state = apply_notify(config, [sm, others], sec(1800))
+    sm_after = [(O, sec(600)), (S, sec(1200)), (S, sec(1800) + PROP)]
+    assert_state_matches_chains(state, [sm_after, others], sec(1800), config)
+    assert np.asarray(state.stale).tolist() == [0, 0]
+
+
+def test_case_g_lead_was_2_reveal_all(config):
+    """test.cpp:285-296: lead drops to 1 — the pool reveals everything to
+    avoid a race."""
+    sm = [(O, sec(600)), (S, sec(1200)), (S, None), (S, None)]
+    others = [(O, sec(600)), (S, sec(1200)), (O, sec(1800))]
+    state = apply_notify(config, [sm, others], sec(1800))
+    sm_after = [(O, sec(600)), (S, sec(1200)), (S, sec(1800) + PROP), (S, sec(1800) + PROP)]
+    assert_state_matches_chains(state, [sm_after, others], sec(1800), config)
+
+
+def test_case_h_lead_over_2_reveal_oldest(config):
+    """test.cpp:298-314: lead stays >= 2 — reveal only the oldest block."""
+    sm = [(O, sec(600)), (S, sec(1200)), (S, None), (S, None), (S, None)]
+    others = [(O, sec(600)), (S, sec(1200)), (O, sec(1800))]
+    state = apply_notify(config, [sm, others], sec(1800))
+    sm_after = [(O, sec(600)), (S, sec(1200)), (S, sec(1800) + PROP), (S, None), (S, None)]
+    assert_state_matches_chains(state, [sm_after, others], sec(1800), config)
+
+
+def test_case_h_long_fork_reveal_oldest(config):
+    """test.cpp:316-330: 5-block private fork, best 4 — reveal one."""
+    sm = [(O, sec(600)), (S, sec(1200)), (O, sec(1800))] + [(S, None)] * 5
+    others = [(O, sec(600)), (S, sec(1200)), (O, sec(1800)), (O, sec(2400))]
+    state = apply_notify(config, [sm, others], sec(2400))
+    sm_after = (
+        [(O, sec(600)), (S, sec(1200)), (O, sec(1800)), (S, sec(2400) + PROP)]
+        + [(S, None)] * 4
+    )
+    assert_state_matches_chains(state, [sm_after, others], sec(2400), config)
+
+
+def test_extra_case_two_blocks_in_a_row_reveal_two(config):
+    """test.cpp:332-350 (absent from the paper): others found two blocks in a
+    row — the pool reveals two of its oldest private blocks."""
+    sm = [(O, sec(600)), (S, sec(1200)), (O, sec(1800))] + [(S, None)] * 5
+    others = [
+        (O, sec(600)),
+        (S, sec(1200)),
+        (O, sec(1800)),
+        (O, sec(2400)),
+        (O, sec(3000)),
+    ]
+    state = apply_notify(config, [sm, others], sec(3000))
+    sm_after = (
+        [
+            (O, sec(600)),
+            (S, sec(1200)),
+            (O, sec(1800)),
+            (S, sec(3000) + PROP),
+            (S, sec(3000) + PROP),
+        ]
+        + [(S, None)] * 3
+    )
+    assert_state_matches_chains(state, [sm_after, others], sec(3000), config)
+
+
+def test_extra_case_lead_1_others_find_two_switch(config):
+    """test.cpp:352-364 (absent from the paper): lead 1, others find two in a
+    row — the pool switches to the longer public chain."""
+    sm = [(O, sec(600)), (S, sec(1200)), (O, sec(1800)), (S, None)]
+    best = [
+        (O, sec(600)),
+        (S, sec(1200)),
+        (O, sec(1800)),
+        (O, sec(2400)),
+        (O, sec(3000)),
+    ]
+    state = apply_notify(config, [sm, best], sec(3000))
+    assert_state_matches_chains(state, [best, best], sec(3000), config)
+    assert np.asarray(state.stale).tolist() == [1, 0]

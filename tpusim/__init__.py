@@ -1,0 +1,52 @@
+"""tpusim — a TPU-native (JAX/XLA) Bitcoin mining simulation framework.
+
+Re-implements, TPU-first, the full capability surface of the reference C++ simulator
+(darosior/miningsimulation): exponential block arrivals, hashrate-weighted winner
+draws, a binary propagation model, longest-chain consensus with the first-seen
+tiebreak, gamma=0 selfish mining, and per-miner revenue/stale statistics
+aggregated over tens of thousands of independent Monte-Carlo runs.
+
+Architecture (nothing here is a translation of the reference's C++):
+  * every per-miner ``std::vector<Block>`` chain (reference simulation.h:41-202) is
+    collapsed into O(1) fixed-shape integer state per (run, miner);
+  * the event loop (reference main.cpp:128-192) becomes a ``jax.lax.scan`` state
+    machine, one vectorized step per event, vmapped over a runs axis;
+  * run-level parallelism (reference main.cpp:195-220, std::async threads) becomes
+    sharding of the runs axis over a ``jax.sharding.Mesh`` with ``shard_map`` and
+    on-device ``psum`` stat reduction;
+  * an optional native C++ backend (tpusim.backend.cpp) provides the
+    cross-validation oracle.
+
+Times are integer milliseconds; JAX x64 is required and enabled on import.
+"""
+
+import jax
+
+# The simulated timeline is integer milliseconds over up to years: 1 year is
+# ~3.16e10 ms, beyond int32. Enable x64 before any tpusim arrays are created.
+jax.config.update("jax_enable_x64", True)
+
+from .config import (  # noqa: E402
+    MinerConfig,
+    NetworkConfig,
+    SimConfig,
+    default_network,
+    BLOCK_INTERVAL_S,
+    DEFAULT_DURATION_MS,
+)
+from .api import run_simulation  # noqa: E402
+from .stats import MinerStats, SimResults  # noqa: E402
+
+__all__ = [
+    "MinerConfig",
+    "NetworkConfig",
+    "SimConfig",
+    "default_network",
+    "run_simulation",
+    "MinerStats",
+    "SimResults",
+    "BLOCK_INTERVAL_S",
+    "DEFAULT_DURATION_MS",
+]
+
+__version__ = "0.3.0"

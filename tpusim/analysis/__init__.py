@@ -1,0 +1,13 @@
+from .oracle import (
+    analytical_stale_rates,
+    analytical_net_benefits,
+    p_stale_before,
+    p_stale_after,
+)
+
+__all__ = [
+    "analytical_stale_rates",
+    "analytical_net_benefits",
+    "p_stale_before",
+    "p_stale_after",
+]

@@ -1,0 +1,99 @@
+"""Closed-form stale-rate / revenue model — the analytical validation oracle.
+
+Port of the reference's standalone model (reference plot_stale_rate/plot.py:18-77),
+generalized to arbitrary hashrate vectors. For an honest network with binary
+propagation, a miner's block goes stale either because someone else found a
+competing block within the propagation window *before* ours (and then wins the
+1-block race under the first-seen rule at gamma=0 — we only win if we find the
+next block ourselves), or because any other miner finds a competing block
+within the window *after* ours and then also finds the next one.
+
+Used in tests as an independent check of the simulator's honest-path stale
+rates across a propagation sweep; exact only to first order in
+prop/interval (races involving 3+ blocks are neglected), which is far inside
+Monte-Carlo noise for the reference configurations.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+
+def _p_finds_within(prop_s: float, hashrate: float, block_interval_s: float) -> float:
+    """P(a miner with this hashrate share finds a block within prop_s seconds)
+    (reference plot.py:18-26): exponential CDF with thinned rate."""
+    lam = hashrate / block_interval_s
+    return 1.0 - math.exp(-lam * prop_s)
+
+
+def p_stale_before(prop_s: float, hashrate: float, block_interval_s: float = 600.0) -> float:
+    """P(our block goes stale because the rest of the network found one less
+    than prop_s before ours and then wins the race) (reference plot.py:28-33)."""
+    rest = 1.0 - hashrate
+    return _p_finds_within(prop_s, rest, block_interval_s) * rest
+
+
+def p_stale_after(
+    prop_s: float, other_hashrates: Sequence[float], block_interval_s: float = 600.0
+) -> float:
+    """P(any other miner finds a competing block within prop_s after ours and
+    then also finds the next block) (reference plot.py:35-38)."""
+    return sum(
+        _p_finds_within(prop_s, h, block_interval_s) * h for h in other_hashrates
+    )
+
+
+def analytical_stale_rates(
+    hashrates: Sequence[float],
+    prop_s: float | Sequence[float],
+    block_interval_s: float = 600.0,
+) -> list[float]:
+    """Per-miner stale rates for an honest network (reference plot.py:40-56).
+
+    ``prop_s`` may be one propagation time (seconds) for all miners or one per
+    miner. A block of miner ``i`` goes stale "before" when a competitor ``j``
+    found a block that was still inside *j's* propagation window when ours
+    appeared (and someone else finds the next block), and "after" when ``j``
+    finds a competing block inside *our* window and then also finds the next
+    one. With homogeneous propagation the "before" term collapses to the
+    reference's lumped rest-of-network formula (plot.py:28-33), reproduced
+    exactly in that case.
+    """
+    n = len(hashrates)
+    props = [float(prop_s)] * n if isinstance(prop_s, (int, float)) else [float(p) for p in prop_s]
+    homogeneous = all(p == props[0] for p in props)
+    rates = []
+    for i, h in enumerate(hashrates):
+        if homogeneous:
+            before = p_stale_before(props[i], h, block_interval_s)
+        else:
+            before = sum(
+                _p_finds_within(props[j], hashrates[j], block_interval_s)
+                for j in range(n)
+                if j != i
+            ) * (1.0 - h)
+        after = sum(
+            _p_finds_within(props[i], hashrates[j], block_interval_s) * hashrates[j]
+            for j in range(n)
+            if j != i
+        )
+        rates.append(before + after)
+    return rates
+
+
+def analytical_net_benefits(
+    hashrates: Sequence[float],
+    prop_s: float | Sequence[float],
+    block_interval_s: float = 600.0,
+) -> list[float]:
+    """Relative revenue change per miner once difficulty retargets — share of
+    *non-stale* blocks versus raw hashrate (reference plot.py:58-77)."""
+    rates = analytical_stale_rates(hashrates, prop_s, block_interval_s)
+    total_stale = sum(h * r for h, r in zip(hashrates, rates))
+    total_found = 1.0 - total_stale
+    out = []
+    for h, r in zip(hashrates, rates):
+        actual_share = h * (1.0 - r) / total_found
+        out.append((actual_share - h) / h)
+    return out

@@ -1,0 +1,18 @@
+"""Public API: ``run_simulation(config) -> SimResults``."""
+
+from __future__ import annotations
+
+from .config import SimConfig
+from .stats import SimResults
+
+
+def run_simulation(config: SimConfig, **kwargs) -> SimResults:
+    """Run a full Monte-Carlo simulation as configured.
+
+    Library-level equivalent of the reference's ``main()`` driver
+    (main.cpp:195-235). See :func:`tpusim.runner.run_simulation_config` for
+    orchestration keyword arguments (mesh, checkpoint_path, progress, ...).
+    """
+    from .runner import run_simulation_config
+
+    return run_simulation_config(config, **kwargs)

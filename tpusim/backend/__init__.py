@@ -1,0 +1,32 @@
+"""Simulation backends behind the ``SimBackend`` boundary.
+
+  * ``tpu``     — the JAX engine (tpusim.engine); default, used by the runner.
+  * ``pychain`` — a literal materialized-chain simulator in pure Python with
+    the reference's exact semantics; the in-repo behavioral oracle.
+  * ``cpp``     — a native C++ re-implementation (compiled on demand), the
+    performance-credible cross-validation oracle, replacing the reference's
+    std::async runner (main.cpp:195-220).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+def get_backend(name: str) -> Callable:
+    if name == "tpu":
+        from ..api import run_simulation
+
+        return run_simulation
+    if name == "pychain":
+        from .pychain import run_simulation_pychain
+
+        return run_simulation_pychain
+    if name == "cpp":
+        from .cpp import run_simulation_cpp
+
+        return run_simulation_cpp
+    raise KeyError(f"unknown backend {name!r}; have tpu, pychain, cpp")
+
+
+__all__ = ["get_backend"]

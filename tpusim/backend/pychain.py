@@ -1,0 +1,207 @@
+"""Literal materialized-chain simulator: the in-repo behavioral oracle.
+
+This backend keeps every miner's chain as an explicit list, exactly like the
+reference's ``std::vector<Block>`` model (reference simulation.h:41-202,
+main.cpp:68-192), so the O(1)-state TPU automaton can be checked against it
+block by block (tests/test_state_equivalence.py). It is intentionally simple
+and slow; it exists for correctness, not throughput.
+
+Blocks are (owner, arrival) pairs with ``arrival is None`` for a selfish
+miner's private blocks (the reference's SELFISH_ARRIVAL sentinel,
+simulation.h:20). The genesis block is implicit: chain lists exclude it, and
+an empty published chain has tip arrival 0 (Block::Genesis, simulation.h:31-33).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+from ..config import SimConfig
+
+Block = tuple[int, int | None]  # (owner_idx, arrival_ms or None for private)
+
+
+@dataclasses.dataclass
+class ChainMiner:
+    idx: int
+    propagation_ms: int
+    selfish: bool
+    chain: list[Block] = dataclasses.field(default_factory=list)
+    stale: int = 0
+
+    # -- chain queries (reference simulation.h:79-121) ----------------------
+    def unpublished(self, t: int) -> int:
+        n = 0
+        for owner, arrival in reversed(self.chain):
+            if arrival is not None and arrival <= t:
+                break
+            n += 1
+        return n
+
+    def published_chain(self, t: int) -> list[Block]:
+        n = self.unpublished(t)
+        return self.chain[: len(self.chain) - n]
+
+    def next_arrival(self, t: int) -> int | None:
+        earliest: int | None = None
+        for owner, arrival in reversed(self.chain):
+            if arrival is not None and arrival <= t:
+                break
+            if arrival is not None:
+                earliest = arrival
+        return earliest
+
+    def private_len(self) -> int:
+        n = 0
+        for owner, arrival in reversed(self.chain):
+            if arrival is not None:
+                break
+            n += 1
+        return n
+
+    # -- events (reference simulation.h:62-76,124-180) ----------------------
+    def found_block(self, t: int, best_chain_len: int) -> None:
+        """best_chain_len counts blocks excluding genesis."""
+        if self.selfish:
+            one_block_race = self.private_len() == 1 and best_chain_len == len(self.chain)
+            if one_block_race:
+                owner, _ = self.chain[-1]
+                self.chain[-1] = (owner, t + self.propagation_ms)
+                self.chain.append((self.idx, t + self.propagation_ms))
+            else:
+                self.chain.append((self.idx, None))
+        else:
+            self.chain.append((self.idx, t + self.propagation_ms))
+
+    def maybe_selfish_reveal(self, best: list[Block], t: int) -> None:
+        if not self.selfish or len(best) > len(self.chain):
+            return
+        private = self.private_len()
+        lead = len(self.chain) - len(best)
+        if private > lead:
+            reveal = private if (private > 1 and lead == 1) else private - lead
+            start = len(self.chain) - private
+            for i in range(start, start + reveal):
+                self.chain[i] = (self.chain[i][0], t + self.propagation_ms)
+
+    def maybe_reorg(self, best: list[Block]) -> None:
+        if len(best) <= len(self.chain):
+            return
+        while self.chain and self.chain[-1] != best[len(self.chain) - 1]:
+            owner, _ = self.chain.pop()
+            if owner == self.idx:
+                self.stale += 1
+        self.chain.extend(best[len(self.chain) :])
+
+    def notify(self, best: list[Block], t: int) -> None:
+        self.maybe_selfish_reveal(best, t)
+        self.maybe_reorg(best)
+
+
+def best_chain(miners: Sequence[ChainMiner], t: int) -> list[Block]:
+    """Longest published chain, first-seen tiebreak (reference main.cpp:68-82).
+    Genesis is implicit: an empty published chain has tip arrival 0."""
+    best: list[Block] = []
+    have = False
+    for miner in miners:
+        pub = miner.published_chain(t)
+        tip = pub[-1][1] if pub else 0
+        best_tip = best[-1][1] if best else 0
+        if not have or len(pub) > len(best) or (len(pub) == len(best) and tip < best_tip):
+            best = pub
+            have = True
+    return list(best)
+
+
+def earliest_arrival(miners: Sequence[ChainMiner], t: int) -> int | None:
+    earliest: int | None = None
+    for miner in miners:
+        a = miner.next_arrival(t)
+        if a is not None and (earliest is None or a < earliest):
+            earliest = a
+    return earliest
+
+
+def run_chain_sim(
+    config: SimConfig, intervals: Sequence[int], winners: Sequence[int]
+) -> dict[str, list]:
+    """One run driven by pre-drawn (interval, winner) sequences.
+
+    Event loop semantics of the reference (main.cpp:128-192): drain all block
+    finds due at the current time, recompute the best chain, notify every
+    miner, then cut through to the earliest next event. Returns per-miner
+    stats measured against the best chain at ``duration`` (main.cpp:185-191)
+    plus the raw final chains for state-equivalence checks.
+    """
+    miners = [
+        ChainMiner(idx=i, propagation_ms=mc.propagation_ms, selfish=mc.selfish)
+        for i, mc in enumerate(config.network.miners)
+    ]
+    duration = config.duration_ms
+    i_interval, i_winner = 1, 0
+    next_block = int(intervals[0])
+    best_len_prev = 0  # genesis-only best chain
+
+    t = 0
+    while t < duration:
+        while t == next_block:
+            miners[winners[i_winner]].found_block(t, best_len_prev)
+            i_winner += 1
+            next_block += int(intervals[i_interval])
+            i_interval += 1
+        best = best_chain(miners, t)
+        for miner in miners:
+            miner.notify(best, t)
+        best_len_prev = len(best)
+        arrival = earliest_arrival(miners, t)
+        t = next_block if arrival is None else min(next_block, arrival)
+
+    final_best = best_chain(miners, duration)
+    found = [sum(1 for owner, _ in final_best if owner == m.idx) for m in miners]
+    denom = max(len(final_best), 1)
+    return {
+        "blocks_found": found,
+        "blocks_share": [f / denom if f > 0 else 0.0 for f in found],
+        "stale_rate": [m.stale / f if f > 0 else 0.0 for m, f in zip(miners, found)],
+        "stale_blocks": [m.stale for m in miners],
+        "best_height": len(final_best),
+        "chains": [list(m.chain) for m in miners],
+    }
+
+
+def run_simulation_pychain(config: SimConfig, rng=None) -> dict[str, list]:
+    """Multi-run pychain backend with numpy-drawn events (statistical use).
+
+    Interval semantics match tpusim.sampling.draw_interval_ms: exponential in
+    ns, rounded, truncated to ms (reference simulation.h:205-210)."""
+    import numpy as np
+
+    rng = np.random.default_rng(config.seed if rng is None else rng)
+    pcts = np.array([m.hashrate_pct for m in config.network.miners], dtype=np.float64)
+    probs = pcts / pcts.sum()
+    mean_ns = config.network.block_interval_s * 1e9
+    expected_blocks = config.duration_ms / (config.network.block_interval_s * 1000.0)
+    n_draw = int(2 * expected_blocks + 100)
+
+    totals = {"blocks_found": 0.0, "blocks_share": 0.0, "stale_rate": 0.0}
+    per_run = []
+    for _ in range(config.runs):
+        intervals = (np.rint(rng.exponential(mean_ns, size=n_draw)).astype(np.int64) // 1_000_000)
+        winners = rng.choice(len(probs), size=n_draw, p=probs)
+        per_run.append(run_chain_sim(config, intervals.tolist(), winners.tolist()))
+    return {
+        "per_run": per_run,
+        "blocks_found_mean": [
+            sum(r["blocks_found"][i] for r in per_run) / config.runs
+            for i in range(config.network.n_miners)
+        ],
+        "blocks_share_mean": [
+            sum(r["blocks_share"][i] for r in per_run) / config.runs
+            for i in range(config.network.n_miners)
+        ],
+        "stale_rate_mean": [
+            sum(r["stale_rate"][i] for r in per_run) / config.runs
+            for i in range(config.network.n_miners)
+        ],
+    }

@@ -1,0 +1,119 @@
+"""Command-line interface.
+
+Reproduces the reference driver's canonical output format (main.cpp:223-234)
+on top of the declarative config system, plus structured JSON emission and a
+sweep mode covering the BASELINE.json configurations — the reference's
+edit-and-recompile workflow (README.md:21-27) becomes flags/config files.
+
+Examples:
+    python -m tpusim --runs 1024 --propagation-ms 10000
+    python -m tpusim --hashrates 40,19,12,11,8,5,3,1,1 --selfish 0
+    python -m tpusim --config sweep.json --json out.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .config import DEFAULT_DURATION_MS, DEFAULT_RUNS, MinerConfig, NetworkConfig, SimConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpusim", description=__doc__)
+    p.add_argument("--config", type=Path, help="JSON SimConfig (overrides network flags)")
+    p.add_argument("--runs", type=int, default=DEFAULT_RUNS)
+    p.add_argument("--duration-ms", type=int, default=DEFAULT_DURATION_MS)
+    p.add_argument("--days", type=float, help="duration in days (overrides --duration-ms)")
+    p.add_argument(
+        "--hashrates",
+        type=str,
+        default="30,29,12,11,8,5,3,1,1",
+        help="comma-separated integer hashrate percentages (must sum to 100)",
+    )
+    p.add_argument(
+        "--propagation-ms",
+        type=str,
+        default="1000",
+        help="propagation in ms: one value for all miners, or comma-separated per miner",
+    )
+    p.add_argument("--selfish", type=str, default="", help="comma-separated selfish miner indices")
+    p.add_argument("--block-interval-s", type=float, default=600.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--batch-size", type=int, default=4096)
+    p.add_argument("--mode", choices=("auto", "exact", "fast"), default="auto")
+    p.add_argument("--checkpoint", type=Path, help="npz path for batch-level checkpoint/resume")
+    p.add_argument("--json", type=Path, help="also write structured results to this path")
+    p.add_argument("--single-device", action="store_true", help="disable multi-device sharding")
+    p.add_argument("--quiet", action="store_true", help="suppress progress output")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> SimConfig:
+    if args.config:
+        return SimConfig.from_json(args.config.read_text())
+    hashrates = [int(x) for x in args.hashrates.split(",")]
+    props = [int(x) for x in args.propagation_ms.split(",")]
+    if len(props) == 1:
+        props = props * len(hashrates)
+    if len(props) != len(hashrates):
+        raise SystemExit("--propagation-ms must have 1 value or one per miner")
+    selfish = {int(x) for x in args.selfish.split(",") if x != ""}
+    miners = tuple(
+        MinerConfig(hashrate_pct=h, propagation_ms=pr, selfish=(i in selfish))
+        for i, (h, pr) in enumerate(zip(hashrates, props))
+    )
+    duration_ms = int(args.days * 86_400_000) if args.days else args.duration_ms
+    return SimConfig(
+        network=NetworkConfig(miners=miners, block_interval_s=args.block_interval_s),
+        duration_ms=duration_ms,
+        runs=args.runs,
+        seed=args.seed,
+        batch_size=args.batch_size,
+        mode=args.mode,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        config = config_from_args(args)
+    except ValueError as e:
+        raise SystemExit(f"error: {e}") from None
+
+    import jax
+
+    from .runner import run_simulation_config
+
+    n_dev = len(jax.devices())
+    print(
+        f"Running {config.runs} simulations in parallel using {n_dev} "
+        f"{jax.devices()[0].platform} device(s)."
+    )
+
+    def progress(done: int, total: int) -> None:
+        print(f"\r{done * 100 // total}% progress..", end="", flush=True)
+
+    results = run_simulation_config(
+        config,
+        use_all_devices=not args.single_device,
+        progress=None if args.quiet else progress,
+        checkpoint_path=args.checkpoint,
+    )
+    if not args.quiet:
+        print()
+    print(results.table())
+    if results.truncated_runs or results.overflow_total:
+        print(
+            f"  [diagnostics: {results.truncated_runs} truncated runs, "
+            f"{results.overflow_total} group-slot overflows]"
+        )
+    if args.json:
+        args.json.write_text(results.to_json())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,180 @@
+"""Declarative simulation configuration.
+
+Replaces the reference's compile-time constants and edit-and-recompile roster:
+``SIM_DURATION``/``SIM_RUNS`` (reference main.cpp:7-10), ``BLOCK_INTERVAL``/
+``PERC_MULTIPLIER``/``SELFISH_ARRIVAL`` (reference simulation.h:16-20) and
+``SetupMiners()`` (reference main.cpp:44-65) with plain dataclasses that can be
+built in code, loaded from JSON, or driven from the CLI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+#: Expected time between blocks in seconds (reference simulation.h:16).
+BLOCK_INTERVAL_S = 600.0
+
+#: Maps integer percentages in [0, 100] onto [0, uint64::max] for the winner
+#: draw thresholds (reference simulation.h:18).
+PERC_MULTIPLIER = (2**64 - 1) // 100
+
+#: 12 reference months of 2'629'746 s each, in milliseconds: 365.2425 days
+#: (reference main.cpp:7 with std::chrono::months{12}).
+DEFAULT_DURATION_MS = 12 * 2_629_746 * 1000
+
+#: Default number of Monte-Carlo runs (reference main.cpp:10).
+DEFAULT_RUNS = 16 * 2048
+
+
+@dataclasses.dataclass(frozen=True)
+class MinerConfig:
+    """One miner: hashrate share, propagation delay, strategy.
+
+    Mirrors the ``Miner`` constructor parameters (reference simulation.h:57-59):
+    integer percent of network hashrate, a binary propagation delay (the time
+    before which this miner's blocks have reached nobody and after which they
+    have reached everybody), and the optional gamma=0 selfish strategy flag.
+    """
+
+    hashrate_pct: int
+    propagation_ms: int = 1000
+    selfish: bool = False
+
+    def __post_init__(self) -> None:
+        if not 0 <= self.hashrate_pct <= 100:
+            raise ValueError(f"hashrate_pct must be in [0, 100], got {self.hashrate_pct}")
+        if self.propagation_ms < 0:
+            raise ValueError(f"propagation_ms must be >= 0, got {self.propagation_ms}")
+
+
+@dataclasses.dataclass(frozen=True)
+class NetworkConfig:
+    """The miner roster. Hashrate percentages must sum to 100, as asserted by
+    the reference's winner draw (reference simulation.h:220)."""
+
+    miners: tuple[MinerConfig, ...]
+    block_interval_s: float = BLOCK_INTERVAL_S
+
+    def __post_init__(self) -> None:
+        if not self.miners:
+            raise ValueError("network needs at least one miner")
+        total = sum(m.hashrate_pct for m in self.miners)
+        if total != 100:
+            raise ValueError(f"miner hashrate percentages must sum to 100, got {total}")
+        if self.block_interval_s <= 0:
+            raise ValueError("block_interval_s must be positive")
+
+    @property
+    def n_miners(self) -> int:
+        return len(self.miners)
+
+    @property
+    def any_selfish(self) -> bool:
+        return any(m.selfish for m in self.miners)
+
+
+def default_network(
+    propagation_ms: int = 1000,
+    selfish_ids: tuple[int, ...] = (),
+    hashrates: tuple[int, ...] | None = None,
+) -> NetworkConfig:
+    """The 9-miner 2025 pool distribution of the reference (main.cpp:44-65):
+    30/29/12/11/8/5/3/1/1 percent, homogeneous propagation."""
+    if hashrates is None:
+        hashrates = (30, 29, 12, 11, 8, 5, 3, 1, 1)
+    miners = tuple(
+        MinerConfig(hashrate_pct=h, propagation_ms=propagation_ms, selfish=(i in selfish_ids))
+        for i, h in enumerate(hashrates)
+    )
+    return NetworkConfig(miners=miners)
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """Full simulation configuration: network + duration + run plan.
+
+    ``mode`` selects the consensus-state representation:
+      * ``"exact"`` — 3-index common-prefix owner counts; observationally exact
+        reorg/stale accounting for every configuration including selfish miners.
+      * ``"fast"``  — pairwise counts only; exact for honest-dominant dynamics
+        (third-party divergence deeper than a direct fork is approximated, an
+        event whose probability is O((prop/interval)^2) per race and which is
+        immaterial at the ±1e-4 stale-rate tolerance).
+      * ``"auto"``  — ``exact`` when any miner is selfish, else ``fast``.
+    """
+
+    network: NetworkConfig
+    duration_ms: int = DEFAULT_DURATION_MS
+    runs: int = DEFAULT_RUNS
+    seed: int = 0
+    batch_size: int = 4096
+    group_slots: int = 4
+    mode: str = "auto"
+    max_steps: int | None = None
+
+    def __post_init__(self) -> None:
+        if self.duration_ms <= 0:
+            raise ValueError("duration_ms must be positive")
+        if self.runs <= 0:
+            raise ValueError("runs must be positive")
+        if self.mode not in ("auto", "exact", "fast"):
+            raise ValueError(f"mode must be auto|exact|fast, got {self.mode!r}")
+        if self.group_slots < 2:
+            raise ValueError("group_slots must be >= 2")
+
+    @property
+    def resolved_mode(self) -> str:
+        if self.mode != "auto":
+            return self.mode
+        return "exact" if self.network.any_selfish else "fast"
+
+    def to_json(self) -> str:
+        return json.dumps(_config_to_dict(self), indent=2)
+
+    @staticmethod
+    def from_json(text: str) -> "SimConfig":
+        return _config_from_dict(json.loads(text))
+
+
+def _config_to_dict(cfg: SimConfig) -> dict[str, Any]:
+    return {
+        "network": {
+            "block_interval_s": cfg.network.block_interval_s,
+            "miners": [
+                {
+                    "hashrate_pct": m.hashrate_pct,
+                    "propagation_ms": m.propagation_ms,
+                    "selfish": m.selfish,
+                }
+                for m in cfg.network.miners
+            ],
+        },
+        "duration_ms": cfg.duration_ms,
+        "runs": cfg.runs,
+        "seed": cfg.seed,
+        "batch_size": cfg.batch_size,
+        "group_slots": cfg.group_slots,
+        "mode": cfg.mode,
+    }
+
+
+def _config_from_dict(d: dict[str, Any]) -> SimConfig:
+    net = d["network"]
+    miners = tuple(
+        MinerConfig(
+            hashrate_pct=int(m["hashrate_pct"]),
+            propagation_ms=int(m.get("propagation_ms", 1000)),
+            selfish=bool(m.get("selfish", False)),
+        )
+        for m in net["miners"]
+    )
+    network = NetworkConfig(miners=miners, block_interval_s=float(net.get("block_interval_s", BLOCK_INTERVAL_S)))
+    kwargs: dict[str, Any] = {}
+    for key in ("duration_ms", "runs", "seed", "batch_size", "group_slots"):
+        if key in d:
+            kwargs[key] = int(d[key])
+    if "mode" in d:
+        kwargs["mode"] = str(d["mode"])
+    return SimConfig(network=network, **kwargs)

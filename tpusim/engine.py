@@ -1,0 +1,157 @@
+"""Event-driven simulation engine: one lax.scan step per event, vmapped over runs.
+
+Reformulates the reference event loop (``RunSimulation``, main.cpp:128-192) as a
+fixed-trip-count ``jax.lax.scan`` over the O(1) automaton of :mod:`tpusim.state`:
+
+  reference iteration                      scan step
+  ------------------------------------     ------------------------------------
+  while (cur_time == next_block_time)      one found-event per step; the notify
+      PickFinder + FoundBlock              is skipped while another same-ms find
+      next_block_time += interval          is due, reproducing the while-drain
+  BestChain + NotifyBestChain(all)         notify() (flush, best, reveal, reorg)
+  best_chain_size = best.size()            best_height_prev
+  cut-through to min(next_block,           t = max(min(next_block_time,
+      EarliestArrival)                         earliest_arrival), t)
+
+Each run sees a different event count, so the scan runs a Poisson upper bound
+of steps with a per-run done mask; a run that would exceed the bound (tail
+probability ~1e-13 at the default margin) is flagged ``truncated`` rather than
+silently biased. RNG is counter-based: every (run, step) derives its interval
+and winner keys by fold_in, so draws are independent of execution order —
+replacing the reference's two per-run xoroshiro streams (main.cpp:131-134).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .config import SimConfig
+from .sampling import draw_interval_ms, draw_winner
+from .state import (
+    I64,
+    SimParams,
+    SimState,
+    earliest_arrival,
+    final_stats,
+    found_block,
+    init_state,
+    make_params,
+    notify,
+)
+
+__all__ = ["default_n_steps", "simulate_run", "simulate_batch", "batch_stat_sums"]
+
+
+def default_n_steps(duration_ms: int, block_interval_s: float) -> int:
+    """Upper bound on event-loop iterations: found events + arrival events
+    <= 2x the block count. Sized at mean + 8 sigma of the Poisson block count
+    (per-run overflow probability ~1e-13)."""
+    mu = duration_ms / (block_interval_s * 1000.0)
+    return int(2.0 * (mu + 8.0 * math.sqrt(mu + 1.0))) + 16
+
+
+def _tree_select(pred: jax.Array, new, old):
+    return jax.tree_util.tree_map(lambda a, b: jnp.where(pred, a, b), new, old)
+
+
+def _step(state: SimState, step_idx: jax.Array, run_key: jax.Array, params: SimParams) -> SimState:
+    duration = jnp.asarray(params.duration_ms, I64)
+    active = state.t < duration
+
+    kf = jax.random.fold_in(run_key, step_idx)
+    w = draw_winner(jax.random.fold_in(kf, 1), params.thresholds)
+    dt = draw_interval_ms(jax.random.fold_in(kf, 0), params.mean_interval_ns)
+
+    found_due = active & (state.t == state.next_block_time)
+    after_found = found_block(state, params, w)
+    after_found = after_found._replace(next_block_time=state.t + dt)
+    state1 = _tree_select(found_due, after_found, state)
+
+    # Another find due at the same millisecond: defer the notify, matching the
+    # reference's while-drain (main.cpp:151-157). Between two same-ms finds no
+    # published state changes (all stamps are in the future), so deferral is
+    # only load-bearing for 0ms-propagation configs.
+    skip_notify = found_due & (state1.next_block_time == state.t)
+    notified = notify(state1, params)
+    state2 = _tree_select(active & ~skip_notify, notified, state1)
+
+    # Cut-through to the next event (main.cpp:173-182). The max() guard keeps
+    # time in place when a same-ms find is still pending (unflushed arrivals
+    # could otherwise pull the min below cur_time).
+    new_t = jnp.maximum(jnp.minimum(state2.next_block_time, earliest_arrival(state2)), state2.t)
+    state3 = state2._replace(t=new_t)
+    return _tree_select(active, state3, state)
+
+
+def simulate_run(
+    run_key: jax.Array, params: SimParams, n_steps: int, n_miners: int, group_slots: int, exact: bool
+) -> dict[str, jax.Array]:
+    """Simulate one full run and return its per-miner stats."""
+    state = init_state(n_miners, group_slots, exact)
+    first_interval = draw_interval_ms(jax.random.fold_in(run_key, n_steps), params.mean_interval_ns)
+    state = state._replace(next_block_time=first_interval)
+
+    def body(carry: SimState, idx: jax.Array):
+        return _step(carry, idx, run_key, params), None
+
+    state, _ = jax.lax.scan(body, state, jnp.arange(n_steps))
+    return final_stats(state, params)
+
+
+@partial(jax.jit, static_argnames=("n_steps", "n_miners", "group_slots", "exact"))
+def simulate_batch(
+    keys: jax.Array, params: SimParams, n_steps: int, n_miners: int, group_slots: int, exact: bool
+) -> dict[str, jax.Array]:
+    """vmap of :func:`simulate_run` over a batch of run keys.
+
+    This is the TPU replacement for the reference's thread fan-out
+    (main.cpp:205-213): runs become a vectorized leading axis instead of
+    std::async tasks."""
+    sim = partial(
+        simulate_run,
+        params=params,
+        n_steps=n_steps,
+        n_miners=n_miners,
+        group_slots=group_slots,
+        exact=exact,
+    )
+    return jax.vmap(sim)(keys)
+
+
+def batch_stat_sums(per_run: dict[str, jax.Array]) -> dict[str, jax.Array]:
+    """Reduce per-run stats to the sums the runner accumulates across batches.
+
+    Mirrors ``MinerStats::operator+=`` accumulation (main.cpp:34-40,214-216):
+    ratios are summed per run and divided by the run count at the very end, so
+    the reported stale rate is a mean of per-run ratios, not a ratio of sums.
+    """
+    return {
+        "blocks_found_sum": jnp.sum(per_run["blocks_found"], axis=0),
+        "blocks_share_sum": jnp.sum(per_run["blocks_share"], axis=0, dtype=jnp.float64),
+        "stale_rate_sum": jnp.sum(per_run["stale_rate"], axis=0, dtype=jnp.float64),
+        "stale_blocks_sum": jnp.sum(per_run["stale_blocks"], axis=0),
+        "best_height_sum": jnp.sum(per_run["best_height"]),
+        "overflow_sum": jnp.sum(per_run["overflow"]),
+        "truncated_sum": jnp.sum(per_run["truncated"].astype(jnp.int64)),
+        "runs": jnp.asarray(per_run["truncated"].shape[0], jnp.int64),
+    }
+
+
+def make_batch_fn(config: SimConfig):
+    """Build (params, jitted batch fn keys->stat sums) for a config."""
+    params = make_params(config)
+    n_steps = config.max_steps or default_n_steps(config.duration_ms, config.network.block_interval_s)
+    exact = config.resolved_mode == "exact"
+    m = config.network.n_miners
+
+    def batch_fn(keys: jax.Array) -> dict[str, jax.Array]:
+        per_run = simulate_batch(
+            keys, params, n_steps=n_steps, n_miners=m, group_slots=config.group_slots, exact=exact
+        )
+        return batch_stat_sums(per_run)
+
+    return params, batch_fn

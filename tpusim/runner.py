@@ -1,0 +1,166 @@
+"""Host-side run orchestration: batching, multi-device sharding, checkpoint,
+retry.
+
+This subsystem replaces the reference's thread-pool driver (main.cpp:195-220):
+``SIM_RUNS`` std::async futures batched by hardware_concurrency become jitted
+batches of vmapped runs, optionally sharded over a ``jax.sharding.Mesh`` of TPU
+devices with ``shard_map`` and reduced on-device with ``psum`` — collectives
+ride ICI instead of a shared-memory join. It also supplies the auxiliary
+behaviors the reference lacks (SURVEY.md §5): batch-granular checkpoint/resume
+for preemptible sweeps, and batch-level failure retry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import time
+from pathlib import Path
+from typing import Callable
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from .config import SimConfig
+from .engine import make_batch_fn
+from .stats import SimResults
+
+logger = logging.getLogger("tpusim")
+
+__all__ = ["run_simulation_config", "make_run_keys", "sharded_batch_fn"]
+
+
+def make_run_keys(seed: int, start: int, count: int) -> jax.Array:
+    """Deterministic per-run keys from a global run index, independent of
+    batching — so a resumed or differently-batched sweep samples identically."""
+    base = jax.random.key(seed)
+    return jax.vmap(lambda i: jax.random.fold_in(base, i))(jnp.arange(start, start + count))
+
+
+def sharded_batch_fn(batch_fn: Callable, mesh: Mesh) -> Callable:
+    """Wrap a keys->stat-sums batch function to shard the runs axis over a
+    device mesh, reducing the sums with an on-device psum (the TPU-native form
+    of the reference's stats_total accumulation, main.cpp:211-216)."""
+
+    def shard_local(keys: jax.Array) -> dict[str, jax.Array]:
+        local = batch_fn(keys)
+        return jax.tree_util.tree_map(lambda x: jax.lax.psum(x, "runs"), local)
+
+    # check_vma off: the scan carry is initialized from unvarying constants
+    # but becomes varying over the sharded runs axis after the first step.
+    mapped = shard_map(shard_local, mesh=mesh, in_specs=P("runs"), out_specs=P(), check_vma=False)
+    return jax.jit(mapped)
+
+
+def _zero_sums(template: dict[str, jax.Array]) -> dict[str, np.ndarray]:
+    return {k: np.zeros_like(np.asarray(v)) for k, v in template.items()}
+
+
+@dataclasses.dataclass
+class _Checkpoint:
+    path: Path
+    fingerprint: str  # config JSON; a resumed sweep must match it exactly
+
+    def load(self) -> tuple[int, dict[str, np.ndarray]] | None:
+        if not self.path.exists():
+            return None
+        with np.load(self.path, allow_pickle=False) as data:
+            saved_fp = str(data["__config__"])
+            if saved_fp != self.fingerprint:
+                raise ValueError(
+                    f"checkpoint {self.path} was written by a different config; "
+                    f"refusing to merge statistics across configs"
+                )
+            runs_done = int(data["__runs_done__"])
+            sums = {k: data[k] for k in data.files if not k.startswith("__")}
+        return runs_done, sums
+
+    def save(self, runs_done: int, sums: dict[str, np.ndarray]) -> None:
+        tmp = self.path.with_suffix(".tmp.npz")
+        np.savez(tmp, __runs_done__=runs_done, __config__=self.fingerprint, **sums)
+        tmp.replace(self.path)
+
+
+def run_simulation_config(
+    config: SimConfig,
+    *,
+    mesh: Mesh | None = None,
+    use_all_devices: bool = True,
+    progress: Callable[[int, int], None] | None = None,
+    checkpoint_path: str | Path | None = None,
+    max_retries: int = 2,
+) -> SimResults:
+    """Run ``config.runs`` simulations and aggregate their statistics.
+
+    Equivalent of the reference's ``main()`` (main.cpp:195-235) minus printing.
+    Runs are processed in jitted batches of ``config.batch_size``; when more
+    than one device is visible (and no explicit mesh is given) the runs axis of
+    each batch is sharded across all devices.
+    """
+    params, batch_fn = make_batch_fn(config)
+    del params
+
+    if mesh is None and use_all_devices and len(jax.devices()) > 1:
+        mesh = Mesh(np.array(jax.devices()), ("runs",))
+
+    n_dev = 1 if mesh is None else mesh.devices.size
+    batch = min(config.batch_size, config.runs)
+    batch -= batch % n_dev or 0
+    batch = max(batch, n_dev)
+    fn = sharded_batch_fn(batch_fn, mesh) if mesh is not None else batch_fn
+
+    # Everything that affects per-run sampling identity; `runs` and
+    # `batch_size` are excluded so a checkpointed sweep can be extended or
+    # re-batched without invalidating accumulated statistics.
+    fp_dict = json.loads(config.to_json())
+    fp_dict.pop("runs", None)
+    fp_dict.pop("batch_size", None)
+    fingerprint = json.dumps(fp_dict, sort_keys=True)
+    ckpt = _Checkpoint(Path(checkpoint_path), fingerprint) if checkpoint_path else None
+    runs_done, sums = 0, None
+    if ckpt is not None and (loaded := ckpt.load()) is not None:
+        runs_done, sums = loaded
+        logger.info("resuming from checkpoint at %d/%d runs", runs_done, config.runs)
+
+    t0 = time.monotonic()
+    compile_s: float | None = None
+    while runs_done < config.runs:
+        this_batch = min(batch, config.runs - runs_done)
+        # A remainder that doesn't fill the mesh runs unsharded rather than
+        # silently rounding the requested run count up or down.
+        batch_sharded = mesh is not None and this_batch % n_dev == 0
+        this_fn = fn if batch_sharded else batch_fn
+        keys = make_run_keys(config.seed, runs_done, this_batch)
+
+        batch_sums = None
+        for attempt in range(max_retries + 1):
+            try:
+                batch_sums = jax.tree_util.tree_map(np.asarray, this_fn(keys))
+                break
+            except Exception:  # noqa: BLE001 — batch-level retry is the point
+                if attempt == max_retries:
+                    raise
+                logger.exception("batch at run %d failed (attempt %d); retrying", runs_done, attempt + 1)
+        assert batch_sums is not None
+
+        if compile_s is None:
+            compile_s = time.monotonic() - t0
+        if sums is None:
+            sums = _zero_sums(batch_sums)
+        for k in sums:
+            sums[k] = sums[k] + batch_sums[k]
+        runs_done += this_batch
+        if ckpt is not None:
+            ckpt.save(runs_done, sums)
+        if progress is not None:
+            progress(runs_done, config.runs)
+
+    elapsed = time.monotonic() - t0
+    assert sums is not None
+    return SimResults.from_sums(
+        sums, config, mode=config.resolved_mode, elapsed_s=elapsed, compile_s=compile_s
+    )

@@ -1,0 +1,79 @@
+"""Vectorized sampling primitives.
+
+Reproduces the reference's sampling *semantics* (not its RNG bitstream — runs
+are seeded independently there too, via std::random_device, reference
+main.cpp:131-134; the cross-validation criterion is distributional):
+
+  * Block intervals: exponential with the mean expressed in nanoseconds,
+    rounded to the nearest nanosecond, then *truncated* to milliseconds
+    (reference simulation.h:205-210 + xoroshiro128++.h:17-20,36-39). The
+    truncation shifts the interval mean by ~-0.5 ms; both backends match it.
+  * Winner draws: a uint64 uniform compared against cumulative integer
+    thresholds ``cumsum(pct) * PERC_MULTIPLIER`` (reference simulation.h:213-221),
+    bit-identical threshold arithmetic.
+
+JAX's threefry generator replaces xoroshiro128++ (reference xoroshiro128++.h:1-40);
+it is counter-based, which is what lets every (run, event) draw be independent
+and order-free under vmap/scan.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .config import PERC_MULTIPLIER
+
+__all__ = ["winner_thresholds", "draw_interval_ms", "draw_winner"]
+
+
+def winner_thresholds(hashrate_pct: np.ndarray) -> np.ndarray:
+    """Cumulative uint64 thresholds for the winner draw.
+
+    Matches ``PickFinder``'s accumulator ``i += perc * PERC_MULTIPLIER``
+    (reference simulation.h:213-221). Computed with Python ints to avoid any
+    intermediate overflow, returned as uint64.
+    """
+    cum: list[int] = []
+    total = 0
+    for p in hashrate_pct:
+        total += int(p) * PERC_MULTIPLIER
+        cum.append(total)
+    if total > 2**64 - 1:
+        raise ValueError("hashrate percentages exceed 100")
+    # Element-wise np.uint64() keeps exactness; a direct array cast of Python
+    # ints above 2**63-1 goes through float and warns.
+    return np.array([np.uint64(c) for c in cum], dtype=np.uint64)
+
+
+def draw_interval_ms(key: jax.Array, mean_interval_ns: float) -> jax.Array:
+    """One exponential block interval, in integer milliseconds (int64).
+
+    Semantics chain, matching the reference exactly:
+    uniform53 = (u64 >> 11) * 2^-53            (xoroshiro128++.h:19)
+    expo_ns   = -log1p(-uniform53) * mean_ns   (xoroshiro128++.h:17-20,36-39)
+    rounded   = round-to-nearest ns            (simulation.h:207, llround)
+    interval  = trunc(rounded / 1e6) ms        (simulation.h:209, duration_cast)
+
+    The only deviation is round-half-to-even (jnp.rint) vs llround's
+    half-away-from-zero, which differs only when the product lands on an exact
+    .5 ns in float64 — measure-zero for this computation.
+    """
+    bits = jax.random.bits(key, dtype=jnp.uint64)
+    uniform = (bits >> jnp.uint64(11)).astype(jnp.float64) * (2.0**-53)
+    expo_ns = -jnp.log1p(-uniform) * mean_interval_ns
+    ns = jnp.rint(expo_ns).astype(jnp.int64)
+    return ns // 1_000_000
+
+
+def draw_winner(key: jax.Array, thresholds: jax.Array) -> jax.Array:
+    """Index of the miner who found the block (int32).
+
+    First miner whose cumulative threshold strictly exceeds a uint64 uniform
+    (reference simulation.h:213-221). The reference asserts on the ~16/2^64
+    draws that fall past the 100% threshold; we clamp to the last miner.
+    """
+    u = jax.random.bits(key, dtype=jnp.uint64)
+    w = jnp.sum((thresholds <= u).astype(jnp.int32))
+    return jnp.minimum(w, jnp.int32(thresholds.shape[0] - 1))

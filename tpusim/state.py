@@ -1,0 +1,377 @@
+"""The O(1)-state chain automaton — the heart of the TPU re-design.
+
+The reference materializes a growing ``std::vector<Block>`` chain per miner and
+resolves consensus by structural chain comparison (reference simulation.h:41-202,
+main.cpp:68-112). Growing per-run chains are a non-starter on TPU (52k blocks x
+2^20 runs cannot be stored, and dynamic shapes defeat XLA). Instead every chain
+is collapsed into fixed-shape integers per (run, miner):
+
+  * ``height``            — own chain length, genesis excluded.
+  * ``n_private``         — trailing private (selfish, unrevealed) own blocks;
+                            the paper's ``privateBranchLen`` and the reference's
+                            ``SelfishBlocks()`` (simulation.h:105-115).
+  * arrival *groups*      — published-but-not-yet-propagated trailing own
+                            blocks, run-length encoded as up to ``K`` (arrival,
+                            count) pairs, sorted by arrival. These carry the
+                            information of ``UnpublishedBlocks``/``NextArrival``
+                            (simulation.h:79-102). Arrived blocks are flushed
+                            into ``base_tip_arrival``.
+  * ``base_tip_arrival``  — arrival time of the highest *arrived* block; the
+                            first-seen tiebreak key (main.cpp:74-76).
+  * ``cp[i, j, o]``       — the consensus sufficient statistic: the number of
+                            blocks owned by miner ``o`` inside the common prefix
+                            of miner ``i``'s and miner ``j``'s chains. This one
+                            tensor replaces every structural chain comparison:
+                            - reorg stale accounting (simulation.h:124-142):
+                              blocks of ``i`` popped when adopting best owner
+                              ``b``'s chain = ``cp[i,i,i] - cp[i,b,i]``;
+                            - final per-miner stats against the best chain
+                              (main.cpp:22-30): ``i``'s blocks in ``b``'s
+                              published chain = ``cp[b,b,i]`` minus ``b``'s
+                              unpublished tail when ``i == b``.
+                            The update rules below are closed under the two
+                            events of the system (own-append, adopt-published),
+                            so the representation is exact — see
+                            tests/test_state_equivalence.py which checks it
+                            against a literal chain simulator on random runs.
+
+A cheaper pairwise variant (``own_above[i,j]``, ``own_in[i,j]``, "fast" mode)
+drops the 3-index tensor; it is exact except when a miner adopts a chain that
+contains its *own* blocks above that chain's fork point with a *third* miner
+that later wins — a multi-branch geometry with probability O((prop/interval)^2)
+per race in honest networks, far below the 1e-4 stale-rate tolerance. Selfish
+configurations route to "exact" mode automatically (deep reorgs there make the
+third-party term first-order).
+
+Everything in this module operates on a single unbatched run; the engine vmaps
+over runs and lax.scans over events.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .config import SimConfig
+from .sampling import winner_thresholds
+
+# Sentinel for "no arrival" (empty group slot / private blocks). Kept well below
+# int64 max so that comparisons never sit at the overflow edge. The reference
+# uses milliseconds::max for private blocks (simulation.h:20).
+INF_TIME = jnp.int64(2**62)
+
+I32 = jnp.int32
+I64 = jnp.int64
+
+
+class SimParams(NamedTuple):
+    """Static per-network arrays, closed over by the jitted step."""
+
+    thresholds: jax.Array  # uint64 [M] cumulative winner-draw thresholds
+    prop_ms: jax.Array  # int64 [M]
+    selfish: jax.Array  # bool [M]
+    mean_interval_ns: float
+    duration_ms: int
+
+
+def make_params(config: SimConfig) -> SimParams:
+    net = config.network
+    return SimParams(
+        thresholds=jnp.asarray(winner_thresholds(np.array([m.hashrate_pct for m in net.miners]))),
+        prop_ms=jnp.asarray([m.propagation_ms for m in net.miners], dtype=I64),
+        selfish=jnp.asarray([m.selfish for m in net.miners], dtype=jnp.bool_),
+        mean_interval_ns=net.block_interval_s * 1e9,
+        duration_ms=config.duration_ms,
+    )
+
+
+class SimState(NamedTuple):
+    """Per-run simulation state (one element of the vmapped batch)."""
+
+    t: jax.Array  # int64 [] current simulation time (ms)
+    next_block_time: jax.Array  # int64 [] absolute time of the next block find
+    best_height_prev: jax.Array  # int32 [] best published height after last notify
+    height: jax.Array  # int32 [M] own chain length (genesis excluded)
+    n_private: jax.Array  # int32 [M] trailing private selfish blocks
+    stale: jax.Array  # int32 [M] own blocks reorged out (simulation.h:133)
+    base_tip_arrival: jax.Array  # int64 [M] arrival of highest arrived block
+    group_arrival: jax.Array  # int64 [M, K] in-flight own block groups (sorted)
+    group_count: jax.Array  # int32 [M, K]
+    overflow: jax.Array  # int32 [] group-slot overflow events (diagnostic)
+    cp: Optional[jax.Array]  # int32 [M, M, M] common-prefix owner counts (exact mode)
+    own_above: Optional[jax.Array]  # int32 [M, M] own blocks above lca (fast mode)
+    own_in: Optional[jax.Array]  # int32 [M, M] own_in[j, i] = i's blocks in j's chain
+
+
+def init_state(n_miners: int, group_slots: int, exact: bool) -> SimState:
+    m, k = n_miners, group_slots
+    return SimState(
+        t=jnp.zeros((), I64),
+        next_block_time=jnp.zeros((), I64),
+        best_height_prev=jnp.zeros((), I32),
+        height=jnp.zeros((m,), I32),
+        n_private=jnp.zeros((m,), I32),
+        stale=jnp.zeros((m,), I32),
+        base_tip_arrival=jnp.zeros((m,), I64),
+        group_arrival=jnp.full((m, k), INF_TIME, I64),
+        group_count=jnp.zeros((m, k), I32),
+        overflow=jnp.zeros((), I32),
+        cp=jnp.zeros((m, m, m), I32) if exact else None,
+        own_above=None if exact else jnp.zeros((m, m), I32),
+        own_in=None if exact else jnp.zeros((m, m), I32),
+    )
+
+
+def _push_groups(
+    arr: jax.Array,
+    cnt: jax.Array,
+    new_arrival: jax.Array,
+    new_count: jax.Array,
+    do: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Append an (arrival, count) group per miner where ``do`` is set.
+
+    Groups stay sorted because every push for a given miner uses a strictly
+    later stamp time with the same propagation delay. Equal-arrival pushes
+    merge into the last group (the publish-both race of simulation.h:66-69
+    produces two blocks with one arrival). A full buffer merges into the last
+    slot, keeping counts exact and arrival = the later one; this bounded-memory
+    fallback is counted in the returned overflow increment.
+    """
+    m, k = arr.shape
+    n = jnp.sum(cnt > 0, axis=-1, dtype=I32)  # [M]
+    last_idx = jnp.maximum(n - 1, 0)
+    last_arrival = jnp.take_along_axis(arr, last_idx[:, None], axis=-1)[:, 0]
+    merge = do & (n > 0) & (last_arrival == new_arrival)
+    overflowed = do & ~merge & (n == k)
+    write_idx = jnp.where(merge | overflowed, last_idx, jnp.minimum(n, k - 1))
+    onehot = (jnp.arange(k)[None, :] == write_idx[:, None]) & do[:, None]
+    arr_new = jnp.where(onehot, new_arrival[:, None], arr)
+    accum = (merge | overflowed)[:, None]
+    cnt_new = jnp.where(onehot, jnp.where(accum, cnt + new_count[:, None], new_count[:, None]), cnt)
+    return arr_new, cnt_new, jnp.sum(overflowed, dtype=I32)
+
+
+def _flush_groups(
+    arr: jax.Array, cnt: jax.Array, base_tip: jax.Array, t: jax.Array
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Move arrived groups (arrival <= t) into the base, compacting the buffer.
+
+    The arrived set is a prefix (groups are sorted), and the new base tip is
+    the arrival of the last flushed group — the chain-highest arrived block,
+    which is exactly the published-chain tip the first-seen rule compares
+    (main.cpp:74-76)."""
+    m, k = arr.shape
+    arrived = arr <= t
+    n_f = jnp.sum(arrived, axis=-1, dtype=I32)
+    flushed_tip = jnp.take_along_axis(arr, jnp.maximum(n_f - 1, 0)[:, None], axis=-1)[:, 0]
+    new_base = jnp.where(n_f > 0, flushed_tip, base_tip)
+    idx = jnp.arange(k)[None, :] + n_f[:, None]
+    valid = idx < k
+    gidx = jnp.minimum(idx, k - 1)
+    arr_new = jnp.where(valid, jnp.take_along_axis(arr, gidx, axis=-1), INF_TIME)
+    cnt_new = jnp.where(valid, jnp.take_along_axis(cnt, gidx, axis=-1), 0)
+    return arr_new, cnt_new, new_base
+
+
+def found_block(state: SimState, params: SimParams, w: jax.Array) -> SimState:
+    """Miner ``w`` finds a block at ``state.t``.
+
+    Semantics of ``Miner::FoundBlock`` (reference simulation.h:62-76):
+      * honest: append an own block arriving at ``t + propagation``;
+      * selfish, not in a 1-block race: append a private block;
+      * selfish winning a 1-block race (exactly one private block and the best
+        published chain matched our length at the last notify): publish the
+        private block *and* the new one, both arriving at ``t + propagation``.
+    """
+    m = state.height.shape[0]
+    onehot_w = jnp.arange(m) == w
+    is_selfish = params.selfish[w]
+    is_race = is_selfish & (state.n_private[w] == 1) & (state.best_height_prev == state.height[w])
+    private_append = is_selfish & ~is_race
+
+    arrival = jnp.full((m,), state.t, I64) + params.prop_ms
+    push_count = jnp.where(is_race, I32(2), I32(1))
+    arr, cnt, over = _push_groups(
+        state.group_arrival,
+        state.group_count,
+        arrival,
+        jnp.full((m,), push_count, I32),
+        onehot_w & ~private_append,
+    )
+    n_private = state.n_private + jnp.where(
+        onehot_w, jnp.where(private_append, I32(1), jnp.where(is_race, I32(-1), I32(0))), I32(0)
+    )
+    height = state.height + onehot_w.astype(I32)
+
+    cp = state.cp
+    own_above, own_in = state.own_above, state.own_in
+    if cp is not None:
+        cp = cp.at[w, w, w].add(1)
+    else:
+        # The new block is above every lca with other miners.
+        own_above = own_above + (onehot_w[:, None] & ~onehot_w[None, :]).astype(I32)
+        own_in = own_in.at[w, w].add(1)
+
+    return state._replace(
+        height=height,
+        n_private=n_private,
+        group_arrival=arr,
+        group_count=cnt,
+        overflow=state.overflow + over,
+        cp=cp,
+        own_above=own_above,
+        own_in=own_in,
+    )
+
+
+def _best_chain(
+    height: jax.Array, n_private: jax.Array, group_count: jax.Array, tip: jax.Array
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Longest published chain with the first-seen tiebreak (main.cpp:68-82).
+
+    Assumes groups hold only unarrived blocks (call after flushing). Returns
+    (owner index, published height per miner, best height, best tip arrival).
+    Ties on both height and tip arrival resolve to the lowest miner index,
+    matching the reference's scan order with strict comparisons.
+    """
+    pub_height = height - n_private - jnp.sum(group_count, axis=-1, dtype=I32)
+    best_h = jnp.max(pub_height)
+    cand = pub_height == best_h
+    tip_masked = jnp.where(cand, tip, INF_TIME)
+    best_tip = jnp.min(tip_masked)
+    b = jnp.argmax(cand & (tip_masked == best_tip)).astype(I32)
+    return b, pub_height, best_h, best_tip
+
+
+def notify(state: SimState, params: SimParams) -> SimState:
+    """One best-chain recompute + notify-all sweep at ``state.t``.
+
+    Mirrors one iteration tail of the reference event loop (main.cpp:160-171):
+    flush arrivals, find the best published chain, let every selfish miner
+    selectively reveal (simulation.h:149-174), then let every miner reorg to
+    the best chain if strictly longer (simulation.h:124-142). The reference
+    iterates miners sequentially against one fixed best-chain span; no miner's
+    notify can affect another's within a sweep, so the vectorized simultaneous
+    update is equivalent.
+    """
+    m = state.height.shape[0]
+    arr, cnt, base_tip = _flush_groups(
+        state.group_arrival, state.group_count, state.base_tip_arrival, state.t
+    )
+    b, pub_height, best_h, best_tip = _best_chain(state.height, state.n_private, cnt, base_tip)
+
+    # --- Selfish reveal (simulation.h:149-174). Runs before reorg; only for
+    # miners whose chain is at least as long as the best published one.
+    lead = state.height - best_h
+    sc = state.n_private
+    can_reveal = params.selfish & (lead >= 0) & (sc > lead)
+    reveal_n = jnp.where((sc > 1) & (lead == 1), sc, sc - lead)
+    arr, cnt, over = _push_groups(
+        arr, cnt, jnp.full((m,), state.t, I64) + params.prop_ms, reveal_n, can_reveal
+    )
+    n_private = jnp.where(can_reveal, sc - reveal_n, sc)
+
+    # --- Reorg (simulation.h:124-142): adopt the best chain when strictly
+    # longer than the *full* local chain (private blocks included).
+    adopt = best_h > state.height
+    unpub_b = state.height[b] - best_h
+
+    cp = state.cp
+    own_above, own_in = state.own_above, state.own_in
+    if cp is not None:
+        own_self = cp[jnp.arange(m), jnp.arange(m), jnp.arange(m)]
+        own_common_b = cp[jnp.arange(m), b, jnp.arange(m)]
+        stale = state.stale + jnp.where(adopt, own_self - own_common_b, 0)
+
+        # Closed-form cp update: every adopter's chain becomes b's published
+        # chain; see module docstring for the case analysis.
+        cpb = cp[b]  # [M, M] common-prefix owner counts of b with each j
+        cpb_pub = cp[b, b, :] - unpub_b * (jnp.arange(m) == b).astype(I32)
+        is_b_i = (jnp.arange(m) == b)[:, None]
+        is_b_j = (jnp.arange(m) == b)[None, :]
+        a_i = adopt[:, None]
+        a_j = adopt[None, :]
+        cond_pub = (a_i & (a_j | is_b_j)) | (is_b_i & a_j)
+        cond_bj = a_i & ~a_j & ~is_b_j
+        cond_bi = ~a_i & ~is_b_i & a_j
+        cp = jnp.where(
+            cond_pub[:, :, None],
+            cpb_pub[None, None, :],
+            jnp.where(cond_bj[:, :, None], cpb[None, :, :], jnp.where(cond_bi[:, :, None], cpb[:, None, :], cp)),
+        )
+    else:
+        stale = state.stale + jnp.where(adopt, own_above[:, b], 0)
+        # Adopter rows: own blocks above any lca become 0 (chain is b_pub, a
+        # prefix-free copy); columns toward adopters copy the column toward b.
+        oa = jnp.where(adopt[None, :], own_above[:, b][:, None], own_above)
+        own_above = jnp.where(adopt[:, None], 0, oa)
+        onehot_b = (jnp.arange(m) == b).astype(I32)
+        own_in_bpub = own_in[b, :] - unpub_b * onehot_b
+        own_in = jnp.where(adopt[:, None], own_in_bpub[None, :], own_in)
+
+    height = jnp.where(adopt, best_h, state.height)
+    n_private = jnp.where(adopt, 0, n_private)
+    arr = jnp.where(adopt[:, None], INF_TIME, arr)
+    cnt = jnp.where(adopt[:, None], 0, cnt)
+    base_tip = jnp.where(adopt, best_tip, base_tip)
+
+    return state._replace(
+        best_height_prev=best_h.astype(I32),
+        height=height,
+        n_private=n_private,
+        stale=stale,
+        base_tip_arrival=base_tip,
+        group_arrival=arr,
+        group_count=cnt,
+        overflow=state.overflow + over,
+        cp=cp,
+        own_above=own_above,
+        own_in=own_in,
+    )
+
+
+def earliest_arrival(state: SimState) -> jax.Array:
+    """Earliest pending block arrival strictly after ``state.t``, INF_TIME if
+    none (reference main.cpp:99-112 + simulation.h:92-102, whose NextArrival
+    only reports arrivals > cur_time)."""
+    return jnp.min(jnp.where(state.group_arrival > state.t, state.group_arrival, INF_TIME))
+
+
+def final_stats(state: SimState, params: SimParams) -> dict[str, jax.Array]:
+    """Per-miner stats against the best chain at ``duration`` (main.cpp:13-41,
+    185-191): blocks found in the best chain, share of the best chain, and
+    stale blocks per found block. All ratios are per-run; the runner averages
+    ratios across runs exactly like the reference (main.cpp:214-216,230-231).
+    """
+    m = state.height.shape[0]
+    t_end = jnp.asarray(params.duration_ms, I64)
+    unarrived = jnp.sum(state.group_count * (state.group_arrival > t_end), axis=-1, dtype=I32)
+    pub_height = state.height - state.n_private - unarrived
+    arrived_mask = state.group_arrival <= t_end
+    last_arrived = jnp.max(jnp.where(arrived_mask, state.group_arrival, -1), axis=-1)
+    tip = jnp.maximum(state.base_tip_arrival, last_arrived)
+
+    best_h = jnp.max(pub_height)
+    cand = pub_height == best_h
+    tip_masked = jnp.where(cand, tip, INF_TIME)
+    b = jnp.argmax(cand & (tip_masked == jnp.min(tip_masked)))
+
+    own_in_b = state.cp[b, b, :] if state.cp is not None else state.own_in[b, :]
+    unpub_b = state.height[b] - pub_height[b]
+    found = (own_in_b - unpub_b * (jnp.arange(m) == b).astype(I32)).astype(jnp.int64)
+    denom = jnp.maximum(best_h, 1).astype(jnp.float64)
+    share = jnp.where(found > 0, found / denom, 0.0)
+    stale_rate = jnp.where(found > 0, state.stale / jnp.maximum(found, 1), 0.0)
+    return {
+        "blocks_found": found,
+        "blocks_share": share,
+        "stale_rate": stale_rate,
+        "stale_blocks": state.stale.astype(jnp.int64),
+        "best_height": best_h.astype(jnp.int64),
+        "overflow": state.overflow.astype(jnp.int64),
+        "truncated": state.t < t_end,
+    }

@@ -1,0 +1,111 @@
+"""Aggregated simulation results.
+
+The reference accumulates per-run ``MinerStats`` into ``stats_total`` and
+prints each field divided by ``SIM_RUNS`` (main.cpp:214-216,230-231) — i.e. a
+mean of per-run ratios. ``SimResults.from_sums`` reproduces that reduction
+exactly; getting it wrong would bias every stale-rate comparison against the
+C++ oracle (ratio-of-sums and mean-of-ratios differ at the 1e-4 level)."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class MinerStats:
+    """Cross-run averages for one miner (reference main.cpp:13-41)."""
+
+    miner_id: int
+    hashrate_pct: int
+    selfish: bool
+    blocks_found_mean: float
+    blocks_share_mean: float
+    stale_rate_mean: float
+    stale_blocks_mean: float
+
+
+@dataclasses.dataclass(frozen=True)
+class SimResults:
+    runs: int
+    duration_ms: int
+    miners: tuple[MinerStats, ...]
+    best_height_mean: float
+    overflow_total: int
+    truncated_runs: int
+    mode: str
+    elapsed_s: float | None = None
+    compile_s: float | None = None
+
+    @staticmethod
+    def from_sums(sums: dict[str, Any], config, mode: str, elapsed_s: float | None = None,
+                  compile_s: float | None = None) -> "SimResults":
+        runs = int(sums["runs"])
+        found = np.asarray(sums["blocks_found_sum"], dtype=np.float64)
+        share = np.asarray(sums["blocks_share_sum"], dtype=np.float64)
+        stale_rate = np.asarray(sums["stale_rate_sum"], dtype=np.float64)
+        stale_blocks = np.asarray(sums["stale_blocks_sum"], dtype=np.float64)
+        miners = tuple(
+            MinerStats(
+                miner_id=i,
+                hashrate_pct=mc.hashrate_pct,
+                selfish=mc.selfish,
+                blocks_found_mean=float(found[i]) / runs,
+                blocks_share_mean=float(share[i]) / runs,
+                stale_rate_mean=float(stale_rate[i]) / runs,
+                stale_blocks_mean=float(stale_blocks[i]) / runs,
+            )
+            for i, mc in enumerate(config.network.miners)
+        )
+        return SimResults(
+            runs=runs,
+            duration_ms=config.duration_ms,
+            miners=miners,
+            best_height_mean=float(sums["best_height_sum"]) / runs,
+            overflow_total=int(sums["overflow_sum"]),
+            truncated_runs=int(sums["truncated_sum"]),
+            mode=mode,
+            elapsed_s=elapsed_s,
+            compile_s=compile_s,
+        )
+
+    @property
+    def duration_days(self) -> int:
+        return int(self.duration_ms / 86_400_000)
+
+    def table(self) -> str:
+        """The reference's canonical human-readable output (main.cpp:223-234),
+        including its integer division of blocks_found by the run count."""
+        lines = [
+            f"After running {self.runs} simulations for {self.duration_days}d each, on average:"
+        ]
+        for ms in self.miners:
+            found_int = int(ms.blocks_found_mean * self.runs) // self.runs
+            line = (
+                f"  - Miner {ms.miner_id} ({ms.hashrate_pct}% of network hashrate) found "
+                f"{found_int} blocks i.e. {ms.blocks_share_mean * 100:g}% of blocks. "
+                f"Stale rate: {ms.stale_rate_mean * 100:g}%."
+            )
+            if ms.selfish:
+                line += " ('selfish mining' strategy)"
+            lines.append(line)
+        return "\n".join(lines)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "runs": self.runs,
+            "duration_ms": self.duration_ms,
+            "mode": self.mode,
+            "elapsed_s": self.elapsed_s,
+            "compile_s": self.compile_s,
+            "best_height_mean": self.best_height_mean,
+            "overflow_total": self.overflow_total,
+            "truncated_runs": self.truncated_runs,
+            "miners": [dataclasses.asdict(m) for m in self.miners],
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)

@@ -1,0 +1,186 @@
+"""Test utilities: drive the O(1) automaton with prescribed events and convert
+between explicit chains and automaton state.
+
+``drive_state_events`` replays the exact per-event logic of
+``tpusim.engine._step`` but with injected (interval, winner) sequences instead
+of keyed draws, so the automaton can be compared step-for-step against the
+literal-chain oracle (tpusim.backend.pychain) on identical event streams.
+
+``state_from_chains`` builds a SimState from explicit per-miner chains —
+mirroring how the reference unit tests construct ``Miner::chain`` literally
+(reference test.cpp:213-367) — so every selfish-strategy case ports as an
+exact-state test of the vectorized kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from .backend.pychain import Block
+from .config import SimConfig
+from .state import (
+    I32,
+    I64,
+    INF_TIME,
+    SimParams,
+    SimState,
+    earliest_arrival,
+    final_stats,
+    found_block,
+    init_state,
+    make_params,
+    notify,
+)
+
+
+def drive_state_events(
+    config: SimConfig, intervals: Sequence[int], winners: Sequence[int]
+) -> tuple[SimState, dict]:
+    """Run one simulation on the automaton with pre-drawn events; returns the
+    final state and final stats. Mirrors engine._step exactly (found-if-due,
+    deferred notify on same-ms finds, cut-through)."""
+    params = make_params(config)
+    exact = config.resolved_mode == "exact"
+    state = init_state(config.network.n_miners, config.group_slots, exact)
+    state = state._replace(next_block_time=jnp.asarray(int(intervals[0]), I64))
+    i_interval, i_winner = 1, 0
+    duration = config.duration_ms
+
+    while int(state.t) < duration:
+        found_due = int(state.t) == int(state.next_block_time)
+        if found_due:
+            state = found_block(state, params, jnp.asarray(winners[i_winner], I32))
+            i_winner += 1
+            state = state._replace(
+                next_block_time=state.t + jnp.asarray(int(intervals[i_interval]), I64)
+            )
+            i_interval += 1
+        skip = found_due and int(state.next_block_time) == int(state.t)
+        if not skip:
+            state = notify(state, params)
+        new_t = max(min(int(state.next_block_time), int(earliest_arrival(state))), int(state.t))
+        state = state._replace(t=jnp.asarray(new_t, I64))
+    return state, {k: np.asarray(v) for k, v in final_stats(state, params).items()}
+
+
+def _common_prefix_owner_counts(chains: Sequence[Sequence[Block]], n_miners: int) -> np.ndarray:
+    m = len(chains)
+    cp = np.zeros((m, m, n_miners), dtype=np.int32)
+    for i in range(m):
+        for j in range(m):
+            for (o1, a1), (o2, a2) in zip(chains[i], chains[j]):
+                if (o1, a1) != (o2, a2):
+                    break
+                cp[i, j, o1] += 1
+    return cp
+
+
+def state_from_chains(
+    chains: Sequence[Sequence[Block]],
+    t: int,
+    config: SimConfig,
+    *,
+    stale: Sequence[int] | None = None,
+    best_height_prev: int | None = None,
+) -> SimState:
+    """Build a SimState equivalent to the given explicit chains at time ``t``.
+
+    Chains are (owner, arrival) lists excluding genesis, arrival=None for
+    private blocks. Raises if a chain violates the invariants the automaton
+    relies on (trailing-only private/unarrived blocks, sorted arrivals)."""
+    m = len(chains)
+    k = config.group_slots
+    exact = config.resolved_mode == "exact"
+    height = np.array([len(c) for c in chains], dtype=np.int32)
+    n_private = np.zeros(m, np.int32)
+    base_tip = np.zeros(m, np.int64)
+    group_arrival = np.full((m, k), int(INF_TIME), np.int64)
+    group_count = np.zeros((m, k), np.int32)
+
+    for i, chain in enumerate(chains):
+        idx = len(chain)
+        while idx > 0 and chain[idx - 1][1] is None:
+            if chain[idx - 1][0] != i:
+                raise ValueError("private blocks must be own blocks")
+            idx -= 1
+        n_private[i] = len(chain) - idx
+        groups: list[tuple[int, int]] = []
+        while idx > 0 and chain[idx - 1][1] is not None and chain[idx - 1][1] > t:
+            owner, arrival = chain[idx - 1]
+            if owner != i:
+                raise ValueError("unarrived blocks must be trailing own blocks")
+            if groups and groups[0][0] == arrival:
+                groups[0] = (arrival, groups[0][1] + 1)
+            else:
+                groups.insert(0, (arrival, 1))
+            idx -= 1
+        if len(groups) > k:
+            raise ValueError(f"needs {len(groups)} group slots, have {k}")
+        for g, (arrival, count) in enumerate(groups):
+            group_arrival[i, g] = arrival
+            group_count[i, g] = count
+        base_tip[i] = chain[idx - 1][1] if idx > 0 else 0
+
+    cp = _common_prefix_owner_counts(chains, m)
+    own_in = np.zeros((m, m), np.int32)
+    own_above = np.zeros((m, m), np.int32)
+    for i in range(m):
+        for owner, _ in chains[i]:
+            own_in[i, owner] += 1
+        own_above[i, :] = own_in[i, i] - cp[i, :, i]
+
+    pub_len = [len(ch) - int(n_private[i]) - int(group_count[i].sum()) for i, ch in enumerate(chains)]
+    return SimState(
+        t=jnp.asarray(t, I64),
+        next_block_time=jnp.asarray(t, I64),
+        best_height_prev=jnp.asarray(
+            max(pub_len) if best_height_prev is None else best_height_prev, I32
+        ),
+        height=jnp.asarray(height),
+        n_private=jnp.asarray(n_private),
+        stale=jnp.asarray(stale if stale is not None else np.zeros(m, np.int32), I32),
+        base_tip_arrival=jnp.asarray(base_tip),
+        group_arrival=jnp.asarray(group_arrival),
+        group_count=jnp.asarray(group_count),
+        overflow=jnp.zeros((), I32),
+        cp=jnp.asarray(cp) if exact else None,
+        own_above=None if exact else jnp.asarray(own_above),
+        own_in=None if exact else jnp.asarray(own_in),
+    )
+
+
+def canonical_view(state: SimState, t: int) -> dict:
+    """Chain-level observable facts of a SimState, for comparison."""
+    m = state.height.shape[0]
+    arrivals = []
+    for i in range(m):
+        expand: list[int] = []
+        for g in range(state.group_arrival.shape[1]):
+            expand += [int(state.group_arrival[i, g])] * int(state.group_count[i, g])
+        arrivals.append(expand)
+    return {
+        "height": np.asarray(state.height).tolist(),
+        "n_private": np.asarray(state.n_private).tolist(),
+        "stale": np.asarray(state.stale).tolist(),
+        "base_tip_arrival": np.asarray(state.base_tip_arrival).tolist(),
+        "inflight_arrivals": arrivals,
+        "cp": None if state.cp is None else np.asarray(state.cp).tolist(),
+        "own_above": None if state.own_above is None else np.asarray(state.own_above).tolist(),
+        "own_in": None if state.own_in is None else np.asarray(state.own_in).tolist(),
+    }
+
+
+def assert_state_matches_chains(
+    state: SimState, chains: Sequence[Sequence[Block]], t: int, config: SimConfig
+) -> None:
+    """Assert a SimState is observationally identical to explicit chains,
+    ignoring bookkeeping that chains don't carry (stale, best_height_prev)."""
+    expected = state_from_chains(
+        chains, t, config, stale=np.asarray(state.stale), best_height_prev=int(state.best_height_prev)
+    )
+    got, want = canonical_view(state, t), canonical_view(expected, t)
+    for key in want:
+        assert got[key] == want[key], f"{key}: got {got[key]}, want {want[key]}"
