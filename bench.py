@@ -29,11 +29,11 @@ def main() -> int:
     import jax
 
     from tpusim import SimConfig, default_network, DEFAULT_DURATION_MS
-    from tpusim.engine import make_batch_fn
+    from tpusim.engine import Engine
     from tpusim.runner import make_run_keys
 
     platform = jax.devices()[0].platform
-    batch = args.batch_size or (4096 if platform != "cpu" else 256)
+    batch = args.batch_size or (8192 if platform != "cpu" else 256)
 
     config = SimConfig(
         network=default_network(propagation_ms=1000),
@@ -42,18 +42,16 @@ def main() -> int:
         batch_size=batch,
         seed=7,
     )
-    _, batch_fn = make_batch_fn(config)
+    engine = Engine(config)
     years_per_run = config.duration_ms / (365.2425 * 86_400_000.0)
 
     # Compile + warm up (first TPU compile is slow and must not be timed).
-    warm = batch_fn(make_run_keys(config.seed, 0, batch))
-    jax.block_until_ready(warm)
+    engine.run_batch(make_run_keys(config.seed, 0, batch))
 
     total_runs = 0
     t0 = time.perf_counter()
     for i in range(args.max_batches):
-        out = batch_fn(make_run_keys(config.seed, (i + 1) * batch, batch))
-        jax.block_until_ready(out)
+        engine.run_batch(make_run_keys(config.seed, (i + 1) * batch, batch))
         total_runs += batch
         if time.perf_counter() - t0 >= args.target_seconds:
             break

@@ -17,14 +17,11 @@ Architecture (nothing here is a translation of the reference's C++):
   * an optional native C++ backend (tpusim.backend.cpp) provides the
     cross-validation oracle.
 
-Times are integer milliseconds; JAX x64 is required and enabled on import.
+Times are integer milliseconds. Everything on device is 32-bit by design —
+TPUs have no native 64-bit ALU — so year-long timelines (~3.16e10 ms) are
+handled by chunked execution with per-chunk clock re-basing (tpusim.engine);
+the host tracks absolute time in int64 numpy. JAX's x64 mode is never needed.
 """
-
-import jax
-
-# The simulated timeline is integer milliseconds over up to years: 1 year is
-# ~3.16e10 ms, beyond int32. Enable x64 before any tpusim arrays are created.
-jax.config.update("jax_enable_x64", True)
 
 from .config import (  # noqa: E402
     MinerConfig,

@@ -105,11 +105,8 @@ def main(argv: list[str] | None = None) -> int:
     if not args.quiet:
         print()
     print(results.table())
-    if results.truncated_runs or results.overflow_total:
-        print(
-            f"  [diagnostics: {results.truncated_runs} truncated runs, "
-            f"{results.overflow_total} group-slot overflows]"
-        )
+    if results.overflow_total:
+        print(f"  [diagnostics: {results.overflow_total} group-slot overflows]")
     if args.json:
         args.json.write_text(results.to_json())
     return 0

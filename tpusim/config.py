@@ -112,7 +112,7 @@ class SimConfig:
     batch_size: int = 4096
     group_slots: int = 4
     mode: str = "auto"
-    max_steps: int | None = None
+    chunk_steps: int | None = None
 
     def __post_init__(self) -> None:
         if self.duration_ms <= 0:
@@ -123,6 +123,15 @@ class SimConfig:
             raise ValueError(f"mode must be auto|exact|fast, got {self.mode!r}")
         if self.group_slots < 2:
             raise ValueError("group_slots must be >= 2")
+        if self.chunk_steps is not None and self.chunk_steps < 1:
+            raise ValueError("chunk_steps must be >= 1 (or None for auto)")
+        # 32-bit time-arithmetic envelope (see tpusim.state docstring): one
+        # interval draw must stay far below INTERVAL_CAP = 2^27 ms, and
+        # propagation delays below one chunk re-base span.
+        if self.network.block_interval_s > 3600.0:
+            raise ValueError("block_interval_s above 3600 s exceeds the int32 time envelope")
+        if any(m.propagation_ms >= 2**24 for m in self.network.miners):
+            raise ValueError("propagation_ms must be below 2^24 ms (~4.7 h)")
 
     @property
     def resolved_mode(self) -> str:
@@ -157,6 +166,7 @@ def _config_to_dict(cfg: SimConfig) -> dict[str, Any]:
         "batch_size": cfg.batch_size,
         "group_slots": cfg.group_slots,
         "mode": cfg.mode,
+        "chunk_steps": cfg.chunk_steps,
     }
 
 
@@ -175,6 +185,8 @@ def _config_from_dict(d: dict[str, Any]) -> SimConfig:
     for key in ("duration_ms", "runs", "seed", "batch_size", "group_slots"):
         if key in d:
             kwargs[key] = int(d[key])
+    if d.get("chunk_steps") is not None:
+        kwargs["chunk_steps"] = int(d["chunk_steps"])
     if "mode" in d:
         kwargs["mode"] = str(d["mode"])
     return SimConfig(network=network, **kwargs)

@@ -1,7 +1,8 @@
-"""Event-driven simulation engine: one lax.scan step per event, vmapped over runs.
+"""Event-driven simulation engine: one lax.scan step per event, vmapped over
+runs, executed in fixed-step *chunks* with a host loop between them.
 
-Reformulates the reference event loop (``RunSimulation``, main.cpp:128-192) as a
-fixed-trip-count ``jax.lax.scan`` over the O(1) automaton of :mod:`tpusim.state`:
+Reformulates the reference event loop (``RunSimulation``, main.cpp:128-192) as
+``jax.lax.scan`` over the O(1) automaton of :mod:`tpusim.state`:
 
   reference iteration                      scan step
   ------------------------------------     ------------------------------------
@@ -13,26 +14,39 @@ fixed-trip-count ``jax.lax.scan`` over the O(1) automaton of :mod:`tpusim.state`
   cut-through to min(next_block,           t = max(min(next_block_time,
       EarliestArrival)                         earliest_arrival), t)
 
-Each run sees a different event count, so the scan runs a Poisson upper bound
-of steps with a per-run done mask; a run that would exceed the bound (tail
-probability ~1e-13 at the default margin) is flagged ``truncated`` rather than
-silently biased. RNG is counter-based: every (run, step) derives its interval
-and winner keys by fold_in, so draws are independent of execution order —
-replacing the reference's two per-run xoroshiro streams (main.cpp:131-134).
+Chunking (the TPU-native shape of "long context"): a year-long run is ~105k
+events, and int32 relative time only spans ~12 days, so the engine executes a
+fixed number of scan steps per jitted call, re-bases every run's clock to 0
+(state.rebase), and lets the host carry absolute elapsed time in int64 numpy.
+This keeps every on-device value 32-bit (TPUs emulate 64-bit at a large
+slowdown), keeps each device call seconds-long (no RPC/timeout cliffs on
+year-long simulations), compiles ONE chunk program reused for any duration,
+and stops as soon as every run in the batch has actually finished — rather
+than provisioning a Poisson upper bound of steps for all runs.
+
+RNG is counter-based: chunk ``c`` of a run draws its (winner, interval) words
+as ``random.bits(fold_in(run_key, 1 + c), (steps, 2))`` — one batched threefry
+per chunk instead of per-step key folding — so draws are independent of
+execution order and of how runs are batched, replacing the reference's two
+per-run xoroshiro streams (main.cpp:131-134). ``chunk_steps`` IS part of the
+sampling identity (it sets the step->key mapping), which is why it is
+serialized with the config and covered by the checkpoint fingerprint.
 """
 
 from __future__ import annotations
 
 import math
-from functools import partial
 
+import numpy as np
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
 
 from .config import SimConfig
-from .sampling import draw_interval_ms, draw_winner
+from .sampling import interval_from_bits, winner_from_bits
 from .state import (
-    I64,
+    TIME_CAP,
     SimParams,
     SimState,
     earliest_arrival,
@@ -41,15 +55,19 @@ from .state import (
     init_state,
     make_params,
     notify,
+    rebase,
 )
 
-__all__ = ["default_n_steps", "simulate_run", "simulate_batch", "batch_stat_sums"]
+__all__ = ["Engine", "default_n_steps", "make_engine"]
+
+#: Per-batch int32 block-count sums stay exact below this many blocks.
+_I32_SUM_GUARD = 2**31 - 1
 
 
 def default_n_steps(duration_ms: int, block_interval_s: float) -> int:
-    """Upper bound on event-loop iterations: found events + arrival events
-    <= 2x the block count. Sized at mean + 8 sigma of the Poisson block count
-    (per-run overflow probability ~1e-13)."""
+    """Upper bound on event-loop iterations for one run: found events +
+    arrival events <= 2x the block count, sized at mean + 8 sigma of the
+    Poisson block count (per-run exceedance ~1e-13)."""
     mu = duration_ms / (block_interval_s * 1000.0)
     return int(2.0 * (mu + 8.0 * math.sqrt(mu + 1.0))) + 16
 
@@ -58,13 +76,13 @@ def _tree_select(pred: jax.Array, new, old):
     return jax.tree_util.tree_map(lambda a, b: jnp.where(pred, a, b), new, old)
 
 
-def _step(state: SimState, step_idx: jax.Array, run_key: jax.Array, params: SimParams) -> SimState:
-    duration = jnp.asarray(params.duration_ms, I64)
-    active = state.t < duration
-
-    kf = jax.random.fold_in(run_key, step_idx)
-    w = draw_winner(jax.random.fold_in(kf, 1), params.thresholds)
-    dt = draw_interval_ms(jax.random.fold_in(kf, 0), params.mean_interval_ns)
+def _step(state: SimState, bits2: jax.Array, params: SimParams, cap: jax.Array) -> SimState:
+    """One event: a block find if one is due at ``t``, then the notify sweep,
+    then cut-through time advance. ``cap`` freezes the run when it passes its
+    chunk-relative end (duration reached, or TIME_CAP pending a re-base)."""
+    active = state.t < cap
+    w = winner_from_bits(bits2[0], params.thresholds)
+    dt = interval_from_bits(bits2[1], params.mean_interval_ms)
 
     found_due = active & (state.t == state.next_block_time)
     after_found = found_block(state, params, w)
@@ -87,71 +105,144 @@ def _step(state: SimState, step_idx: jax.Array, run_key: jax.Array, params: SimP
     return _tree_select(active, state3, state)
 
 
-def simulate_run(
-    run_key: jax.Array, params: SimParams, n_steps: int, n_miners: int, group_slots: int, exact: bool
-) -> dict[str, jax.Array]:
-    """Simulate one full run and return its per-miner stats."""
-    state = init_state(n_miners, group_slots, exact)
-    first_interval = draw_interval_ms(jax.random.fold_in(run_key, n_steps), params.mean_interval_ns)
-    state = state._replace(next_block_time=first_interval)
+class Engine:
+    """Chunked batch executor for one SimConfig.
 
-    def body(carry: SimState, idx: jax.Array):
-        return _step(carry, idx, run_key, params), None
-
-    state, _ = jax.lax.scan(body, state, jnp.arange(n_steps))
-    return final_stats(state, params)
-
-
-@partial(jax.jit, static_argnames=("n_steps", "n_miners", "group_slots", "exact"))
-def simulate_batch(
-    keys: jax.Array, params: SimParams, n_steps: int, n_miners: int, group_slots: int, exact: bool
-) -> dict[str, jax.Array]:
-    """vmap of :func:`simulate_run` over a batch of run keys.
-
-    This is the TPU replacement for the reference's thread fan-out
-    (main.cpp:205-213): runs become a vectorized leading axis instead of
-    std::async tasks."""
-    sim = partial(
-        simulate_run,
-        params=params,
-        n_steps=n_steps,
-        n_miners=n_miners,
-        group_slots=group_slots,
-        exact=exact,
-    )
-    return jax.vmap(sim)(keys)
-
-
-def batch_stat_sums(per_run: dict[str, jax.Array]) -> dict[str, jax.Array]:
-    """Reduce per-run stats to the sums the runner accumulates across batches.
-
-    Mirrors ``MinerStats::operator+=`` accumulation (main.cpp:34-40,214-216):
-    ratios are summed per run and divided by the run count at the very end, so
-    the reported stale rate is a mean of per-run ratios, not a ratio of sums.
+    This object owns the jitted per-chunk programs; :meth:`run_batch` is the
+    TPU replacement for the reference's thread fan-out (main.cpp:205-213):
+    runs become a vectorized leading axis instead of std::async tasks, and
+    with a device mesh the runs axis is sharded via shard_map with final
+    psum-reduced statistics (collectives ride ICI instead of a shared-memory
+    join, SURVEY.md section 2.2).
     """
-    return {
-        "blocks_found_sum": jnp.sum(per_run["blocks_found"], axis=0),
-        "blocks_share_sum": jnp.sum(per_run["blocks_share"], axis=0, dtype=jnp.float64),
-        "stale_rate_sum": jnp.sum(per_run["stale_rate"], axis=0, dtype=jnp.float64),
-        "stale_blocks_sum": jnp.sum(per_run["stale_blocks"], axis=0),
-        "best_height_sum": jnp.sum(per_run["best_height"]),
-        "overflow_sum": jnp.sum(per_run["overflow"]),
-        "truncated_sum": jnp.sum(per_run["truncated"].astype(jnp.int64)),
-        "runs": jnp.asarray(per_run["truncated"].shape[0], jnp.int64),
-    }
 
-
-def make_batch_fn(config: SimConfig):
-    """Build (params, jitted batch fn keys->stat sums) for a config."""
-    params = make_params(config)
-    n_steps = config.max_steps or default_n_steps(config.duration_ms, config.network.block_interval_s)
-    exact = config.resolved_mode == "exact"
-    m = config.network.n_miners
-
-    def batch_fn(keys: jax.Array) -> dict[str, jax.Array]:
-        per_run = simulate_batch(
-            keys, params, n_steps=n_steps, n_miners=m, group_slots=config.group_slots, exact=exact
+    def __init__(self, config: SimConfig, mesh: Mesh | None = None):
+        self.config = config
+        self.mesh = mesh
+        self.params = make_params(config)
+        self.n_miners = config.network.n_miners
+        self.exact = config.resolved_mode == "exact"
+        bound = default_n_steps(config.duration_ms, config.network.block_interval_s)
+        self.chunk_steps = min(config.chunk_steps or 2048, bound)
+        # Host-loop safety margin: generous vs the per-run 8-sigma bound
+        # because the loop must cover the batch *max* event count; the second
+        # term covers runs that freeze at TIME_CAP and re-base repeatedly.
+        self.max_chunks = (
+            (bound + 4 * self.chunk_steps) // self.chunk_steps
+            + config.duration_ms // int(TIME_CAP)
+            + 4
         )
-        return batch_stat_sums(per_run)
 
-    return params, batch_fn
+        m, k, exact, steps = self.n_miners, config.group_slots, self.exact, self.chunk_steps
+
+        def init_fn(run_key: jax.Array, params: SimParams) -> SimState:
+            state = init_state(m, k, exact)
+            bits = jax.random.bits(jax.random.fold_in(run_key, 0), (2,), jnp.uint32)
+            return state._replace(
+                next_block_time=interval_from_bits(bits[1], params.mean_interval_ms)
+            )
+
+        def chunk_fn(
+            state: SimState, cap: jax.Array, run_key: jax.Array, chunk_idx: jax.Array,
+            params: SimParams,
+        ) -> tuple[SimState, jax.Array]:
+            key = jax.random.fold_in(run_key, 1 + chunk_idx)
+            bits = jax.random.bits(key, (steps, 2), jnp.uint32)
+
+            def body(carry: SimState, xs: jax.Array):
+                return _step(carry, xs, params, cap), None
+
+            state, _ = jax.lax.scan(body, state, bits)
+            return rebase(state)
+
+        def finalize_fn(state: SimState, t_end: jax.Array) -> dict[str, jax.Array]:
+            per_run = jax.vmap(final_stats)(state, t_end)
+            return {
+                "blocks_found_sum": jnp.sum(per_run["blocks_found"], axis=0),
+                "blocks_share_sum": jnp.sum(per_run["blocks_share"], axis=0),
+                "stale_rate_sum": jnp.sum(per_run["stale_rate"], axis=0),
+                "stale_blocks_sum": jnp.sum(per_run["stale_blocks"], axis=0),
+                "best_height_sum": jnp.sum(per_run["best_height"]),
+                "overflow_sum": jnp.sum(per_run["overflow"]),
+            }
+
+        vinit = jax.vmap(init_fn, in_axes=(0, None))
+        vchunk = jax.vmap(chunk_fn, in_axes=(0, 0, 0, None, None))
+
+        if mesh is None:
+            self._init = jax.jit(vinit)
+            self._chunk = jax.jit(vchunk)
+            self._finalize = jax.jit(finalize_fn)
+        else:
+            # check_vma off: scan carries start as unvarying constants but
+            # become varying over the sharded runs axis after the first step.
+            rep_params = jax.tree_util.tree_map(lambda _: P(), self.params)
+            self._init = jax.jit(
+                shard_map(
+                    vinit, mesh=mesh,
+                    in_specs=(P("runs"), rep_params), out_specs=P("runs"),
+                    check_vma=False,
+                )
+            )
+            self._chunk = jax.jit(
+                shard_map(
+                    vchunk, mesh=mesh,
+                    in_specs=(P("runs"), P("runs"), P("runs"), P(), rep_params),
+                    out_specs=(P("runs"), P("runs")),
+                    check_vma=False,
+                )
+            )
+
+            def sharded_finalize(state, t_end):
+                local = finalize_fn(state, t_end)
+                return jax.tree_util.tree_map(lambda x: jax.lax.psum(x, "runs"), local)
+
+            self._finalize = jax.jit(
+                shard_map(
+                    sharded_finalize, mesh=mesh,
+                    in_specs=(P("runs"), P("runs")), out_specs=P(),
+                    check_vma=False,
+                )
+            )
+
+    def run_batch(self, keys: jax.Array) -> dict[str, np.ndarray]:
+        """Simulate one batch of runs to completion; returns stat sums.
+
+        Host loop: jitted chunk -> re-base -> subtract elapsed from the int64
+        remaining-time ledger -> repeat until every run's remaining <= 0.
+        """
+        n = keys.shape[0]
+        duration = self.config.duration_ms
+        blocks_bound = n * (duration / (self.config.network.block_interval_s * 1000.0)) * 1.1
+        if blocks_bound > _I32_SUM_GUARD:
+            raise ValueError(
+                f"batch of {n} runs x {duration} ms overflows int32 block-count "
+                f"sums; lower batch_size below {int(_I32_SUM_GUARD / (blocks_bound / n))}"
+            )
+        state = self._init(keys, self.params)
+        remaining = np.full((n,), duration, dtype=np.int64)
+        time_cap = np.int64(int(TIME_CAP))
+
+        for chunk_idx in range(self.max_chunks):
+            cap = jnp.asarray(np.minimum(remaining, time_cap).astype(np.int32))
+            state, elapsed = self._chunk(
+                state, cap, keys, jnp.asarray(chunk_idx, jnp.uint32), self.params
+            )
+            remaining -= np.asarray(elapsed, dtype=np.int64)
+            if np.all(remaining <= 0):
+                break
+        else:
+            raise RuntimeError(
+                f"batch did not finish within {self.max_chunks} chunks of "
+                f"{self.chunk_steps} steps — event count beyond the Poisson bound"
+            )
+
+        t_end = jnp.asarray(remaining.astype(np.int32))
+        sums = self._finalize(state, t_end)
+        out = {k: np.asarray(v) for k, v in sums.items()}
+        out["runs"] = np.int64(n)
+        return out
+
+
+def make_engine(config: SimConfig, mesh: Mesh | None = None) -> Engine:
+    return Engine(config, mesh)

@@ -2,12 +2,13 @@
 retry.
 
 This subsystem replaces the reference's thread-pool driver (main.cpp:195-220):
-``SIM_RUNS`` std::async futures batched by hardware_concurrency become jitted
-batches of vmapped runs, optionally sharded over a ``jax.sharding.Mesh`` of TPU
-devices with ``shard_map`` and reduced on-device with ``psum`` — collectives
-ride ICI instead of a shared-memory join. It also supplies the auxiliary
-behaviors the reference lacks (SURVEY.md §5): batch-granular checkpoint/resume
-for preemptible sweeps, and batch-level failure retry.
+``SIM_RUNS`` std::async futures batched by hardware_concurrency become chunked
+jitted batches of vmapped runs (tpusim.engine.Engine), optionally sharded over
+a ``jax.sharding.Mesh`` of TPU devices with ``shard_map`` and reduced
+on-device with ``psum`` — collectives ride ICI instead of a shared-memory
+join. It also supplies the auxiliary behaviors the reference lacks
+(SURVEY.md section 5): batch-granular checkpoint/resume for preemptible
+sweeps, and batch-level failure retry.
 """
 
 from __future__ import annotations
@@ -22,16 +23,15 @@ from typing import Callable
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from jax.sharding import Mesh
 
 from .config import SimConfig
-from .engine import make_batch_fn
+from .engine import Engine
 from .stats import SimResults
 
 logger = logging.getLogger("tpusim")
 
-__all__ = ["run_simulation_config", "make_run_keys", "sharded_batch_fn"]
+__all__ = ["run_simulation_config", "make_run_keys"]
 
 
 def make_run_keys(seed: int, start: int, count: int) -> jax.Array:
@@ -41,23 +41,9 @@ def make_run_keys(seed: int, start: int, count: int) -> jax.Array:
     return jax.vmap(lambda i: jax.random.fold_in(base, i))(jnp.arange(start, start + count))
 
 
-def sharded_batch_fn(batch_fn: Callable, mesh: Mesh) -> Callable:
-    """Wrap a keys->stat-sums batch function to shard the runs axis over a
-    device mesh, reducing the sums with an on-device psum (the TPU-native form
-    of the reference's stats_total accumulation, main.cpp:211-216)."""
-
-    def shard_local(keys: jax.Array) -> dict[str, jax.Array]:
-        local = batch_fn(keys)
-        return jax.tree_util.tree_map(lambda x: jax.lax.psum(x, "runs"), local)
-
-    # check_vma off: the scan carry is initialized from unvarying constants
-    # but becomes varying over the sharded runs axis after the first step.
-    mapped = shard_map(shard_local, mesh=mesh, in_specs=P("runs"), out_specs=P(), check_vma=False)
-    return jax.jit(mapped)
-
-
-def _zero_sums(template: dict[str, jax.Array]) -> dict[str, np.ndarray]:
-    return {k: np.zeros_like(np.asarray(v)) for k, v in template.items()}
+def _zero_sums(template: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+    return {k: np.zeros_like(v, dtype=np.int64 if v.dtype.kind == "i" else np.float64)
+            for k, v in template.items()}
 
 
 @dataclasses.dataclass
@@ -97,13 +83,10 @@ def run_simulation_config(
     """Run ``config.runs`` simulations and aggregate their statistics.
 
     Equivalent of the reference's ``main()`` (main.cpp:195-235) minus printing.
-    Runs are processed in jitted batches of ``config.batch_size``; when more
-    than one device is visible (and no explicit mesh is given) the runs axis of
-    each batch is sharded across all devices.
+    Runs are processed in batches of ``config.batch_size``; when more than one
+    device is visible (and no explicit mesh is given) the runs axis of each
+    batch is sharded across all devices.
     """
-    params, batch_fn = make_batch_fn(config)
-    del params
-
     if mesh is None and use_all_devices and len(jax.devices()) > 1:
         mesh = Mesh(np.array(jax.devices()), ("runs",))
 
@@ -111,7 +94,11 @@ def run_simulation_config(
     batch = min(config.batch_size, config.runs)
     batch -= batch % n_dev or 0
     batch = max(batch, n_dev)
-    fn = sharded_batch_fn(batch_fn, mesh) if mesh is not None else batch_fn
+
+    engine = Engine(config, mesh)
+    # A trailing remainder that doesn't fill the mesh runs on an unsharded
+    # single-device engine rather than silently changing the run count.
+    engine_unsharded: Engine | None = None
 
     # Everything that affects per-run sampling identity; `runs` and
     # `batch_size` are excluded so a checkpointed sweep can be extended or
@@ -130,16 +117,18 @@ def run_simulation_config(
     compile_s: float | None = None
     while runs_done < config.runs:
         this_batch = min(batch, config.runs - runs_done)
-        # A remainder that doesn't fill the mesh runs unsharded rather than
-        # silently rounding the requested run count up or down.
-        batch_sharded = mesh is not None and this_batch % n_dev == 0
-        this_fn = fn if batch_sharded else batch_fn
+        if mesh is not None and this_batch % n_dev != 0:
+            if engine_unsharded is None:
+                engine_unsharded = Engine(config, None)
+            this_engine = engine_unsharded
+        else:
+            this_engine = engine
         keys = make_run_keys(config.seed, runs_done, this_batch)
 
         batch_sums = None
         for attempt in range(max_retries + 1):
             try:
-                batch_sums = jax.tree_util.tree_map(np.asarray, this_fn(keys))
+                batch_sums = this_engine.run_batch(keys)
                 break
             except Exception:  # noqa: BLE001 — batch-level retry is the point
                 if attempt == max_retries:

@@ -4,17 +4,25 @@ Reproduces the reference's sampling *semantics* (not its RNG bitstream — runs
 are seeded independently there too, via std::random_device, reference
 main.cpp:131-134; the cross-validation criterion is distributional):
 
-  * Block intervals: exponential with the mean expressed in nanoseconds,
-    rounded to the nearest nanosecond, then *truncated* to milliseconds
-    (reference simulation.h:205-210 + xoroshiro128++.h:17-20,36-39). The
-    truncation shifts the interval mean by ~-0.5 ms; both backends match it.
-  * Winner draws: a uint64 uniform compared against cumulative integer
-    thresholds ``cumsum(pct) * PERC_MULTIPLIER`` (reference simulation.h:213-221),
-    bit-identical threshold arithmetic.
+  * Block intervals: the reference draws an exponential with the mean in
+    nanoseconds, rounds to the nearest ns, then *truncates* to milliseconds
+    (reference simulation.h:205-210 + xoroshiro128++.h:17-20,36-39) — i.e.
+    ``floor`` of an exponential expressed in ms, up to the measure-zero set of
+    draws landing within 0.5 ns of an exact ms boundary. The TPU path computes
+    ``floor(Exp(mean_ms))`` directly in float32 (TPUs have no native float64):
+    the mantissa quantization perturbs a draw by at most ~6e-8 relative, which
+    crosses an integer-ms boundary for ~1e-4 of draws, shifting those by 1 ms
+    out of ~600 000 — orders of magnitude below the 1e-4 stale-rate
+    cross-validation tolerance (see tests/test_statistical.py moments checks).
+  * Winner draws: a uniform word compared against cumulative integer
+    thresholds ``cumsum(pct) * multiplier`` (reference simulation.h:213-221).
+    The reference multiplier maps percent onto uint64; the TPU path uses the
+    same construction on uint32 (multiplier ``(2^32-1)//100``), which moves
+    each category boundary by < 3e-8 of probability mass.
 
 JAX's threefry generator replaces xoroshiro128++ (reference xoroshiro128++.h:1-40);
 it is counter-based, which is what lets every (run, event) draw be independent
-and order-free under vmap/scan.
+of execution order under vmap/scan and across differently-sized chunks.
 """
 
 from __future__ import annotations
@@ -25,16 +33,26 @@ import jax.numpy as jnp
 
 from .config import PERC_MULTIPLIER
 
-__all__ = ["winner_thresholds", "draw_interval_ms", "draw_winner"]
+__all__ = [
+    "winner_thresholds",
+    "winner_thresholds32",
+    "interval_from_bits",
+    "winner_from_bits",
+    "PERC_MULTIPLIER32",
+]
+
+#: uint32 analogue of the reference's percent->u64 multiplier (simulation.h:18).
+PERC_MULTIPLIER32 = (2**32 - 1) // 100
+
+#: Clamp on one interval draw in ms; see state.INTERVAL_CAP. At the 600 s
+#: reference mean the exceedance probability is e^-223.
+_INTERVAL_CAP_MS = float(2**27)
 
 
 def winner_thresholds(hashrate_pct: np.ndarray) -> np.ndarray:
-    """Cumulative uint64 thresholds for the winner draw.
-
-    Matches ``PickFinder``'s accumulator ``i += perc * PERC_MULTIPLIER``
-    (reference simulation.h:213-221). Computed with Python ints to avoid any
-    intermediate overflow, returned as uint64.
-    """
+    """Cumulative uint64 thresholds exactly as the reference accumulates them
+    (``i += perc * PERC_MULTIPLIER``, simulation.h:213-221). Used by the
+    bit-compatible native backend; the TPU engine uses the uint32 variant."""
     cum: list[int] = []
     total = 0
     for p in hashrate_pct:
@@ -47,33 +65,32 @@ def winner_thresholds(hashrate_pct: np.ndarray) -> np.ndarray:
     return np.array([np.uint64(c) for c in cum], dtype=np.uint64)
 
 
-def draw_interval_ms(key: jax.Array, mean_interval_ns: float) -> jax.Array:
-    """One exponential block interval, in integer milliseconds (int64).
+def winner_thresholds32(hashrate_pct: np.ndarray) -> np.ndarray:
+    """Cumulative uint32 winner-draw thresholds (TPU-native 32-bit form)."""
+    cum = np.cumsum(np.asarray(hashrate_pct, dtype=np.int64)) * PERC_MULTIPLIER32
+    if int(cum[-1]) > 2**32 - 1:
+        raise ValueError("hashrate percentages exceed 100")
+    return cum.astype(np.uint32)
 
-    Semantics chain, matching the reference exactly:
-    uniform53 = (u64 >> 11) * 2^-53            (xoroshiro128++.h:19)
-    expo_ns   = -log1p(-uniform53) * mean_ns   (xoroshiro128++.h:17-20,36-39)
-    rounded   = round-to-nearest ns            (simulation.h:207, llround)
-    interval  = trunc(rounded / 1e6) ms        (simulation.h:209, duration_cast)
 
-    The only deviation is round-half-to-even (jnp.rint) vs llround's
-    half-away-from-zero, which differs only when the product lands on an exact
-    .5 ns in float64 — measure-zero for this computation.
+def interval_from_bits(bits: jax.Array, mean_interval_ms) -> jax.Array:
+    """Exponential block interval in integer ms (int32) from one uint32 word.
+
+    uniform24 = (u32 >> 8) * 2^-24, expo = -log1p(-u) * mean_ms, floor to ms.
+    The 24-bit uniform caps the tail at ~16.6 means (exceedance e^-16.6); the
+    explicit clamp keeps int32 time arithmetic overflow-free.
     """
-    bits = jax.random.bits(key, dtype=jnp.uint64)
-    uniform = (bits >> jnp.uint64(11)).astype(jnp.float64) * (2.0**-53)
-    expo_ns = -jnp.log1p(-uniform) * mean_interval_ns
-    ns = jnp.rint(expo_ns).astype(jnp.int64)
-    return ns // 1_000_000
+    u = (bits >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(2.0**-24)
+    expo_ms = -jnp.log1p(-u) * jnp.float32(mean_interval_ms)
+    return jnp.minimum(expo_ms, _INTERVAL_CAP_MS).astype(jnp.int32)
 
 
-def draw_winner(key: jax.Array, thresholds: jax.Array) -> jax.Array:
-    """Index of the miner who found the block (int32).
+def winner_from_bits(bits: jax.Array, thresholds: jax.Array) -> jax.Array:
+    """Index of the miner who found the block (int32) from one uint32 word.
 
-    First miner whose cumulative threshold strictly exceeds a uint64 uniform
-    (reference simulation.h:213-221). The reference asserts on the ~16/2^64
+    First miner whose cumulative threshold strictly exceeds the uniform
+    (reference simulation.h:213-221). The reference asserts on the ~96/2^32
     draws that fall past the 100% threshold; we clamp to the last miner.
     """
-    u = jax.random.bits(key, dtype=jnp.uint64)
-    w = jnp.sum((thresholds <= u).astype(jnp.int32))
+    w = jnp.sum((thresholds <= bits).astype(jnp.int32))
     return jnp.minimum(w, jnp.int32(thresholds.shape[0] - 1))

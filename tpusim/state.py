@@ -43,6 +43,20 @@ per race in honest networks, far below the 1e-4 stale-rate tolerance. Selfish
 configurations route to "exact" mode automatically (deep reorgs there make the
 third-party term first-order).
 
+TPU-first numerics: every on-device value is 32-bit. TPUs have no native
+64-bit integer or float ALU (XLA emulates both as 32-bit pairs at a large
+slowdown), so times are int32 milliseconds *relative to a per-chunk origin*:
+the engine re-bases every run's clock to 0 after each fixed-step chunk
+(:func:`rebase`), and the host tracks absolute elapsed time in int64 numpy.
+Sentinels/caps are sized so no int32 arithmetic here can overflow:
+``INF_TIME`` (2^29) > ``TIME_CAP`` (2^28, the farthest a run may advance
+within one chunk before freezing until the next re-base) > ``INTERVAL_CAP``
+(2^27 ms ~ 1.55 days, a clamp on single interval draws whose exceedance
+probability at the 600 s reference mean is e^-223). All cross-miner indexing
+(winner, best-chain owner) is one-hot arithmetic rather than gather/scatter —
+dynamic indexing lowers to serialized gathers on TPU and is the difference
+between a vectorized step and a stalled one.
+
 Everything in this module operates on a single unbatched run; the engine vmaps
 over runs and lax.scans over events.
 """
@@ -56,49 +70,63 @@ import jax
 import jax.numpy as jnp
 
 from .config import SimConfig
-from .sampling import winner_thresholds
-
-# Sentinel for "no arrival" (empty group slot / private blocks). Kept well below
-# int64 max so that comparisons never sit at the overflow edge. The reference
-# uses milliseconds::max for private blocks (simulation.h:20).
-INF_TIME = jnp.int64(2**62)
+from .sampling import winner_thresholds32
 
 I32 = jnp.int32
-I64 = jnp.int64
+#: Time dtype. int32 by design (see module docstring); the name survives from
+#: the earlier 64-bit engine so call sites read as "the time dtype".
+TIME = jnp.int32
+I64 = TIME  # back-compat alias used by tests/testing helpers
+
+#: Sentinel for "no arrival" (empty group slot). Strictly greater than any
+#: reachable in-chunk time. The reference uses milliseconds::max for private
+#: blocks (simulation.h:20); private blocks here are counted, not stored.
+INF_TIME = jnp.int32(2**29)
+
+#: A run freezes (stops advancing within the current chunk) once its relative
+#: clock passes this; the next chunk re-bases it back to 0. Bounds every time
+#: value below INF_TIME.
+TIME_CAP = jnp.int32(2**28)
+
+#: Clamp on a single exponential interval draw, in ms.
+INTERVAL_CAP = jnp.int32(2**27)
+
+#: Re-based past tips clamp here; two competing equal-height tips can never
+#: both be this old (one block per ~10 min), so the first-seen order among
+#: live candidates is preserved.
+NEG_TIME_CAP = jnp.int32(-(2**28))
 
 
 class SimParams(NamedTuple):
     """Static per-network arrays, closed over by the jitted step."""
 
-    thresholds: jax.Array  # uint64 [M] cumulative winner-draw thresholds
-    prop_ms: jax.Array  # int64 [M]
+    thresholds: jax.Array  # uint32 [M] cumulative winner-draw thresholds
+    prop_ms: jax.Array  # int32 [M]
     selfish: jax.Array  # bool [M]
-    mean_interval_ns: float
-    duration_ms: int
+    mean_interval_ms: float
 
 
 def make_params(config: SimConfig) -> SimParams:
     net = config.network
     return SimParams(
-        thresholds=jnp.asarray(winner_thresholds(np.array([m.hashrate_pct for m in net.miners]))),
-        prop_ms=jnp.asarray([m.propagation_ms for m in net.miners], dtype=I64),
+        thresholds=jnp.asarray(winner_thresholds32(np.array([m.hashrate_pct for m in net.miners]))),
+        prop_ms=jnp.asarray([m.propagation_ms for m in net.miners], dtype=I32),
         selfish=jnp.asarray([m.selfish for m in net.miners], dtype=jnp.bool_),
-        mean_interval_ns=net.block_interval_s * 1e9,
-        duration_ms=config.duration_ms,
+        mean_interval_ms=net.block_interval_s * 1e3,
     )
 
 
 class SimState(NamedTuple):
     """Per-run simulation state (one element of the vmapped batch)."""
 
-    t: jax.Array  # int64 [] current simulation time (ms)
-    next_block_time: jax.Array  # int64 [] absolute time of the next block find
+    t: jax.Array  # int32 [] current chunk-relative simulation time (ms)
+    next_block_time: jax.Array  # int32 [] relative time of the next block find
     best_height_prev: jax.Array  # int32 [] best published height after last notify
     height: jax.Array  # int32 [M] own chain length (genesis excluded)
     n_private: jax.Array  # int32 [M] trailing private selfish blocks
     stale: jax.Array  # int32 [M] own blocks reorged out (simulation.h:133)
-    base_tip_arrival: jax.Array  # int64 [M] arrival of highest arrived block
-    group_arrival: jax.Array  # int64 [M, K] in-flight own block groups (sorted)
+    base_tip_arrival: jax.Array  # int32 [M] arrival of highest arrived block
+    group_arrival: jax.Array  # int32 [M, K] in-flight own block groups (sorted)
     group_count: jax.Array  # int32 [M, K]
     overflow: jax.Array  # int32 [] group-slot overflow events (diagnostic)
     cp: Optional[jax.Array]  # int32 [M, M, M] common-prefix owner counts (exact mode)
@@ -109,20 +137,43 @@ class SimState(NamedTuple):
 def init_state(n_miners: int, group_slots: int, exact: bool) -> SimState:
     m, k = n_miners, group_slots
     return SimState(
-        t=jnp.zeros((), I64),
-        next_block_time=jnp.zeros((), I64),
+        t=jnp.zeros((), TIME),
+        next_block_time=jnp.zeros((), TIME),
         best_height_prev=jnp.zeros((), I32),
         height=jnp.zeros((m,), I32),
         n_private=jnp.zeros((m,), I32),
         stale=jnp.zeros((m,), I32),
-        base_tip_arrival=jnp.zeros((m,), I64),
-        group_arrival=jnp.full((m, k), INF_TIME, I64),
+        base_tip_arrival=jnp.zeros((m,), TIME),
+        group_arrival=jnp.full((m, k), INF_TIME, TIME),
         group_count=jnp.zeros((m, k), I32),
         overflow=jnp.zeros((), I32),
         cp=jnp.zeros((m, m, m), I32) if exact else None,
         own_above=None if exact else jnp.zeros((m, m), I32),
         own_in=None if exact else jnp.zeros((m, m), I32),
     )
+
+
+def rebase(state: SimState) -> tuple[SimState, jax.Array]:
+    """Shift the run's clock origin to ``state.t``; returns (state, elapsed).
+
+    Every stored time moves down by ``t`` (INF slots stay INF, old tips clamp
+    at NEG_TIME_CAP); the host adds ``elapsed`` to its int64 absolute clock.
+    Called between chunks so int32 times never overflow on year-long runs.
+    """
+    t = state.t
+    return state._replace(
+        t=jnp.zeros((), TIME),
+        next_block_time=state.next_block_time - t,
+        base_tip_arrival=jnp.maximum(state.base_tip_arrival - t, NEG_TIME_CAP),
+        group_arrival=jnp.where(
+            state.group_arrival >= INF_TIME, INF_TIME, state.group_arrival - t
+        ),
+    ), t
+
+
+def _at(vec: jax.Array, onehot: jax.Array) -> jax.Array:
+    """vec[w] for one-hot w, as arithmetic (no gather)."""
+    return jnp.sum(jnp.where(onehot, vec, 0))
 
 
 def _push_groups(
@@ -142,17 +193,19 @@ def _push_groups(
     fallback is counted in the returned overflow increment.
     """
     m, k = arr.shape
-    n = jnp.sum(cnt > 0, axis=-1, dtype=I32)  # [M]
+    kidx = jnp.arange(k)[None, :]
+    n = jnp.sum((cnt > 0).astype(I32), axis=-1)  # [M]
     last_idx = jnp.maximum(n - 1, 0)
-    last_arrival = jnp.take_along_axis(arr, last_idx[:, None], axis=-1)[:, 0]
+    onehot_last = kidx == last_idx[:, None]
+    last_arrival = jnp.sum(jnp.where(onehot_last, arr, 0), axis=-1)
     merge = do & (n > 0) & (last_arrival == new_arrival)
     overflowed = do & ~merge & (n == k)
     write_idx = jnp.where(merge | overflowed, last_idx, jnp.minimum(n, k - 1))
-    onehot = (jnp.arange(k)[None, :] == write_idx[:, None]) & do[:, None]
+    onehot = (kidx == write_idx[:, None]) & do[:, None]
     arr_new = jnp.where(onehot, new_arrival[:, None], arr)
     accum = (merge | overflowed)[:, None]
     cnt_new = jnp.where(onehot, jnp.where(accum, cnt + new_count[:, None], new_count[:, None]), cnt)
-    return arr_new, cnt_new, jnp.sum(overflowed, dtype=I32)
+    return arr_new, cnt_new, jnp.sum(overflowed.astype(I32))
 
 
 def _flush_groups(
@@ -163,17 +216,19 @@ def _flush_groups(
     The arrived set is a prefix (groups are sorted), and the new base tip is
     the arrival of the last flushed group — the chain-highest arrived block,
     which is exactly the published-chain tip the first-seen rule compares
-    (main.cpp:74-76)."""
+    (main.cpp:74-76). Compaction is a K x K one-hot shift, not a gather."""
     m, k = arr.shape
+    kidx = jnp.arange(k)
     arrived = arr <= t
-    n_f = jnp.sum(arrived, axis=-1, dtype=I32)
-    flushed_tip = jnp.take_along_axis(arr, jnp.maximum(n_f - 1, 0)[:, None], axis=-1)[:, 0]
+    n_f = jnp.sum(arrived.astype(I32), axis=-1)
+    onehot_tip = kidx[None, :] == (n_f - 1)[:, None]
+    flushed_tip = jnp.sum(jnp.where(onehot_tip, arr, 0), axis=-1)
     new_base = jnp.where(n_f > 0, flushed_tip, base_tip)
-    idx = jnp.arange(k)[None, :] + n_f[:, None]
-    valid = idx < k
-    gidx = jnp.minimum(idx, k - 1)
-    arr_new = jnp.where(valid, jnp.take_along_axis(arr, gidx, axis=-1), INF_TIME)
-    cnt_new = jnp.where(valid, jnp.take_along_axis(cnt, gidx, axis=-1), 0)
+    # shifted[m, j] = arr[m, j + n_f[m]]; slots past the end become empty.
+    sel = kidx[None, None, :] == (kidx[None, :, None] + n_f[:, None, None])  # [M, K_dst, K_src]
+    arr_new = jnp.sum(jnp.where(sel, arr[:, None, :], 0), axis=-1)
+    arr_new = jnp.where(jnp.any(sel, axis=-1), arr_new, INF_TIME)
+    cnt_new = jnp.sum(jnp.where(sel, cnt[:, None, :], 0), axis=-1)
     return arr_new, cnt_new, new_base
 
 
@@ -189,11 +244,13 @@ def found_block(state: SimState, params: SimParams, w: jax.Array) -> SimState:
     """
     m = state.height.shape[0]
     onehot_w = jnp.arange(m) == w
-    is_selfish = params.selfish[w]
-    is_race = is_selfish & (state.n_private[w] == 1) & (state.best_height_prev == state.height[w])
+    is_selfish = jnp.any(onehot_w & params.selfish)
+    n_private_w = _at(state.n_private, onehot_w)
+    height_w = _at(state.height, onehot_w)
+    is_race = is_selfish & (n_private_w == 1) & (state.best_height_prev == height_w)
     private_append = is_selfish & ~is_race
 
-    arrival = jnp.full((m,), state.t, I64) + params.prop_ms
+    arrival = state.t + params.prop_ms  # [M]
     push_count = jnp.where(is_race, I32(2), I32(1))
     arr, cnt, over = _push_groups(
         state.group_arrival,
@@ -209,12 +266,13 @@ def found_block(state: SimState, params: SimParams, w: jax.Array) -> SimState:
 
     cp = state.cp
     own_above, own_in = state.own_above, state.own_in
+    w32 = onehot_w.astype(I32)
     if cp is not None:
-        cp = cp.at[w, w, w].add(1)
+        cp = cp + w32[:, None, None] * w32[None, :, None] * w32[None, None, :]
     else:
         # The new block is above every lca with other miners.
         own_above = own_above + (onehot_w[:, None] & ~onehot_w[None, :]).astype(I32)
-        own_in = own_in.at[w, w].add(1)
+        own_in = own_in + w32[:, None] * w32[None, :]
 
     return state._replace(
         height=height,
@@ -234,17 +292,18 @@ def _best_chain(
     """Longest published chain with the first-seen tiebreak (main.cpp:68-82).
 
     Assumes groups hold only unarrived blocks (call after flushing). Returns
-    (owner index, published height per miner, best height, best tip arrival).
+    (owner one-hot, published height per miner, best height, best tip arrival).
     Ties on both height and tip arrival resolve to the lowest miner index,
     matching the reference's scan order with strict comparisons.
     """
-    pub_height = height - n_private - jnp.sum(group_count, axis=-1, dtype=I32)
+    pub_height = height - n_private - jnp.sum(group_count, axis=-1)
     best_h = jnp.max(pub_height)
     cand = pub_height == best_h
     tip_masked = jnp.where(cand, tip, INF_TIME)
     best_tip = jnp.min(tip_masked)
-    b = jnp.argmax(cand & (tip_masked == best_tip)).astype(I32)
-    return b, pub_height, best_h, best_tip
+    winners = cand & (tip_masked == best_tip)
+    onehot_b = winners & (jnp.cumsum(winners.astype(I32)) == 1)  # first true
+    return onehot_b, pub_height, best_h, best_tip
 
 
 def notify(state: SimState, params: SimParams) -> SimState:
@@ -262,7 +321,10 @@ def notify(state: SimState, params: SimParams) -> SimState:
     arr, cnt, base_tip = _flush_groups(
         state.group_arrival, state.group_count, state.base_tip_arrival, state.t
     )
-    b, pub_height, best_h, best_tip = _best_chain(state.height, state.n_private, cnt, base_tip)
+    onehot_b, pub_height, best_h, best_tip = _best_chain(
+        state.height, state.n_private, cnt, base_tip
+    )
+    b32 = onehot_b.astype(I32)
 
     # --- Selfish reveal (simulation.h:149-174). Runs before reorg; only for
     # miners whose chain is at least as long as the best published one.
@@ -270,29 +332,32 @@ def notify(state: SimState, params: SimParams) -> SimState:
     sc = state.n_private
     can_reveal = params.selfish & (lead >= 0) & (sc > lead)
     reveal_n = jnp.where((sc > 1) & (lead == 1), sc, sc - lead)
-    arr, cnt, over = _push_groups(
-        arr, cnt, jnp.full((m,), state.t, I64) + params.prop_ms, reveal_n, can_reveal
-    )
+    arr, cnt, over = _push_groups(arr, cnt, state.t + params.prop_ms, reveal_n, can_reveal)
     n_private = jnp.where(can_reveal, sc - reveal_n, sc)
 
     # --- Reorg (simulation.h:124-142): adopt the best chain when strictly
     # longer than the *full* local chain (private blocks included).
     adopt = best_h > state.height
-    unpub_b = state.height[b] - best_h
+    unpub_b = _at(state.height, onehot_b) - best_h
 
     cp = state.cp
     own_above, own_in = state.own_above, state.own_in
     if cp is not None:
-        own_self = cp[jnp.arange(m), jnp.arange(m), jnp.arange(m)]
-        own_common_b = cp[jnp.arange(m), b, jnp.arange(m)]
+        eye = jnp.eye(m, dtype=I32)
+        # cp[i, i, i]: own blocks in own chain.
+        own_self = jnp.sum(cp * eye[:, :, None] * eye[:, None, :], axis=(1, 2))
+        # cp[i, b, i]: own blocks in the common prefix with b.
+        cp_b_cols = jnp.sum(cp * b32[None, :, None], axis=1)  # [i, o] = cp[i, b, o]
+        own_common_b = jnp.sum(cp_b_cols * eye, axis=1)
         stale = state.stale + jnp.where(adopt, own_self - own_common_b, 0)
 
         # Closed-form cp update: every adopter's chain becomes b's published
         # chain; see module docstring for the case analysis.
-        cpb = cp[b]  # [M, M] common-prefix owner counts of b with each j
-        cpb_pub = cp[b, b, :] - unpub_b * (jnp.arange(m) == b).astype(I32)
-        is_b_i = (jnp.arange(m) == b)[:, None]
-        is_b_j = (jnp.arange(m) == b)[None, :]
+        cpb = jnp.sum(cp * b32[:, None, None], axis=0)  # [M, M]: cp[b, j, o]
+        cpb_bb = jnp.sum(cpb * b32[:, None], axis=0)  # [M]: cp[b, b, o]
+        cpb_pub = cpb_bb - unpub_b * b32
+        is_b_i = onehot_b[:, None]
+        is_b_j = onehot_b[None, :]
         a_i = adopt[:, None]
         a_j = adopt[None, :]
         cond_pub = (a_i & (a_j | is_b_j)) | (is_b_i & a_j)
@@ -301,16 +366,21 @@ def notify(state: SimState, params: SimParams) -> SimState:
         cp = jnp.where(
             cond_pub[:, :, None],
             cpb_pub[None, None, :],
-            jnp.where(cond_bj[:, :, None], cpb[None, :, :], jnp.where(cond_bi[:, :, None], cpb[:, None, :], cp)),
+            jnp.where(
+                cond_bj[:, :, None],
+                cpb[None, :, :],
+                jnp.where(cond_bi[:, :, None], cpb[:, None, :], cp),
+            ),
         )
     else:
-        stale = state.stale + jnp.where(adopt, own_above[:, b], 0)
+        own_above_b = jnp.sum(own_above * b32[None, :], axis=-1)  # [M] = own_above[:, b]
+        stale = state.stale + jnp.where(adopt, own_above_b, 0)
         # Adopter rows: own blocks above any lca become 0 (chain is b_pub, a
         # prefix-free copy); columns toward adopters copy the column toward b.
-        oa = jnp.where(adopt[None, :], own_above[:, b][:, None], own_above)
+        oa = jnp.where(adopt[None, :], own_above_b[:, None], own_above)
         own_above = jnp.where(adopt[:, None], 0, oa)
-        onehot_b = (jnp.arange(m) == b).astype(I32)
-        own_in_bpub = own_in[b, :] - unpub_b * onehot_b
+        own_in_b = jnp.sum(own_in * b32[:, None], axis=0)  # [M] = own_in[b, :]
+        own_in_bpub = own_in_b - unpub_b * b32
         own_in = jnp.where(adopt[:, None], own_in_bpub[None, :], own_in)
 
     height = jnp.where(adopt, best_h, state.height)
@@ -320,7 +390,7 @@ def notify(state: SimState, params: SimParams) -> SimState:
     base_tip = jnp.where(adopt, best_tip, base_tip)
 
     return state._replace(
-        best_height_prev=best_h.astype(I32),
+        best_height_prev=best_h,
         height=height,
         n_private=n_private,
         stale=stale,
@@ -341,37 +411,46 @@ def earliest_arrival(state: SimState) -> jax.Array:
     return jnp.min(jnp.where(state.group_arrival > state.t, state.group_arrival, INF_TIME))
 
 
-def final_stats(state: SimState, params: SimParams) -> dict[str, jax.Array]:
-    """Per-miner stats against the best chain at ``duration`` (main.cpp:13-41,
+def final_stats(state: SimState, t_end: jax.Array) -> dict[str, jax.Array]:
+    """Per-miner stats against the best chain at ``t_end`` (main.cpp:13-41,
     185-191): blocks found in the best chain, share of the best chain, and
-    stale blocks per found block. All ratios are per-run; the runner averages
-    ratios across runs exactly like the reference (main.cpp:214-216,230-231).
+    stale blocks per found block. ``t_end`` is the simulation end time in the
+    run's current (re-based) frame — the same frame as every stored arrival.
+    All ratios are per-run; the runner averages ratios across runs exactly like
+    the reference (main.cpp:214-216,230-231).
     """
     m = state.height.shape[0]
-    t_end = jnp.asarray(params.duration_ms, I64)
-    unarrived = jnp.sum(state.group_count * (state.group_arrival > t_end), axis=-1, dtype=I32)
+    unarrived = jnp.sum(state.group_count * (state.group_arrival > t_end), axis=-1)
     pub_height = state.height - state.n_private - unarrived
     arrived_mask = state.group_arrival <= t_end
-    last_arrived = jnp.max(jnp.where(arrived_mask, state.group_arrival, -1), axis=-1)
+    last_arrived = jnp.max(jnp.where(arrived_mask, state.group_arrival, NEG_TIME_CAP), axis=-1)
     tip = jnp.maximum(state.base_tip_arrival, last_arrived)
 
     best_h = jnp.max(pub_height)
     cand = pub_height == best_h
     tip_masked = jnp.where(cand, tip, INF_TIME)
-    b = jnp.argmax(cand & (tip_masked == jnp.min(tip_masked)))
+    winners = cand & (tip_masked == jnp.min(tip_masked))
+    onehot_b = winners & (jnp.cumsum(winners.astype(I32)) == 1)
+    b32 = onehot_b.astype(I32)
 
-    own_in_b = state.cp[b, b, :] if state.cp is not None else state.own_in[b, :]
-    unpub_b = state.height[b] - pub_height[b]
-    found = (own_in_b - unpub_b * (jnp.arange(m) == b).astype(I32)).astype(jnp.int64)
-    denom = jnp.maximum(best_h, 1).astype(jnp.float64)
-    share = jnp.where(found > 0, found / denom, 0.0)
-    stale_rate = jnp.where(found > 0, state.stale / jnp.maximum(found, 1), 0.0)
+    if state.cp is not None:
+        cp_b = jnp.sum(state.cp * b32[:, None, None], axis=0)  # [j, o] = cp[b, j, o]
+        own_in_b = jnp.sum(cp_b * b32[:, None], axis=0)  # [o] = cp[b, b, o]
+    else:
+        own_in_b = jnp.sum(state.own_in * b32[:, None], axis=0)
+    unpub_b = _at(state.height, onehot_b) - best_h
+    found = own_in_b - unpub_b * b32
+    denom = jnp.maximum(best_h, 1).astype(jnp.float32)
+    fpos = found > 0
+    share = jnp.where(fpos, found.astype(jnp.float32) / denom, 0.0)
+    stale_rate = jnp.where(
+        fpos, state.stale.astype(jnp.float32) / jnp.maximum(found, 1).astype(jnp.float32), 0.0
+    )
     return {
         "blocks_found": found,
         "blocks_share": share,
         "stale_rate": stale_rate,
-        "stale_blocks": state.stale.astype(jnp.int64),
-        "best_height": best_h.astype(jnp.int64),
-        "overflow": state.overflow.astype(jnp.int64),
-        "truncated": state.t < t_end,
+        "stale_blocks": state.stale,
+        "best_height": best_h,
+        "overflow": state.overflow,
     }

@@ -35,7 +35,6 @@ class SimResults:
     miners: tuple[MinerStats, ...]
     best_height_mean: float
     overflow_total: int
-    truncated_runs: int
     mode: str
     elapsed_s: float | None = None
     compile_s: float | None = None
@@ -66,7 +65,6 @@ class SimResults:
             miners=miners,
             best_height_mean=float(sums["best_height_sum"]) / runs,
             overflow_total=int(sums["overflow_sum"]),
-            truncated_runs=int(sums["truncated_sum"]),
             mode=mode,
             elapsed_s=elapsed_s,
             compile_s=compile_s,
@@ -103,7 +101,6 @@ class SimResults:
             "compile_s": self.compile_s,
             "best_height_mean": self.best_height_mean,
             "overflow_total": self.overflow_total,
-            "truncated_runs": self.truncated_runs,
             "miners": [dataclasses.asdict(m) for m in self.miners],
         }
 

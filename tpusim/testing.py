@@ -23,9 +23,8 @@ from .backend.pychain import Block
 from .config import SimConfig
 from .state import (
     I32,
-    I64,
     INF_TIME,
-    SimParams,
+    TIME,
     SimState,
     earliest_arrival,
     final_stats,
@@ -45,9 +44,10 @@ def drive_state_events(
     params = make_params(config)
     exact = config.resolved_mode == "exact"
     state = init_state(config.network.n_miners, config.group_slots, exact)
-    state = state._replace(next_block_time=jnp.asarray(int(intervals[0]), I64))
+    state = state._replace(next_block_time=jnp.asarray(int(intervals[0]), TIME))
     i_interval, i_winner = 1, 0
     duration = config.duration_ms
+    assert duration < 2**28, "drive_state_events runs un-rebased; keep durations < TIME_CAP"
 
     while int(state.t) < duration:
         found_due = int(state.t) == int(state.next_block_time)
@@ -55,15 +55,18 @@ def drive_state_events(
             state = found_block(state, params, jnp.asarray(winners[i_winner], I32))
             i_winner += 1
             state = state._replace(
-                next_block_time=state.t + jnp.asarray(int(intervals[i_interval]), I64)
+                next_block_time=state.t + jnp.asarray(int(intervals[i_interval]), TIME)
             )
             i_interval += 1
         skip = found_due and int(state.next_block_time) == int(state.t)
         if not skip:
             state = notify(state, params)
         new_t = max(min(int(state.next_block_time), int(earliest_arrival(state))), int(state.t))
-        state = state._replace(t=jnp.asarray(new_t, I64))
-    return state, {k: np.asarray(v) for k, v in final_stats(state, params).items()}
+        state = state._replace(t=jnp.asarray(new_t, TIME))
+    return state, {
+        k: np.asarray(v)
+        for k, v in final_stats(state, jnp.asarray(duration, TIME)).items()
+    }
 
 
 def _common_prefix_owner_counts(chains: Sequence[Sequence[Block]], n_miners: int) -> np.ndarray:
@@ -96,8 +99,8 @@ def state_from_chains(
     exact = config.resolved_mode == "exact"
     height = np.array([len(c) for c in chains], dtype=np.int32)
     n_private = np.zeros(m, np.int32)
-    base_tip = np.zeros(m, np.int64)
-    group_arrival = np.full((m, k), int(INF_TIME), np.int64)
+    base_tip = np.zeros(m, np.int32)
+    group_arrival = np.full((m, k), int(INF_TIME), np.int32)
     group_count = np.zeros((m, k), np.int32)
 
     for i, chain in enumerate(chains):
@@ -134,8 +137,8 @@ def state_from_chains(
 
     pub_len = [len(ch) - int(n_private[i]) - int(group_count[i].sum()) for i, ch in enumerate(chains)]
     return SimState(
-        t=jnp.asarray(t, I64),
-        next_block_time=jnp.asarray(t, I64),
+        t=jnp.asarray(t, TIME),
+        next_block_time=jnp.asarray(t, TIME),
         best_height_prev=jnp.asarray(
             max(pub_len) if best_height_prev is None else best_height_prev, I32
         ),
