@@ -1,0 +1,205 @@
+"""State-equivalence suite: the O(1) automaton vs the literal-chain oracle.
+
+Two layers of evidence that the fixed-shape automaton (tpusim.state) is
+observationally equivalent to the reference's materialized-chain model
+(reference simulation.h:41-202, main.cpp:68-192, reproduced in
+tpusim.backend.pychain):
+
+1. ``test_event_stream_equivalence``: both models consume identical injected
+   (interval, winner) event streams; the final automaton state must match the
+   oracle's final chains block for block (exact mode) and the final per-miner
+   statistics must agree exactly.
+
+2. ``test_engine_matches_pychain_replay``: the full jitted engine — lax.scan
+   chunks, re-basing, freezing, vmapped runs — is compared against a host-side
+   replica that drives the chain oracle with the *same counter-based RNG
+   draws* (same threefry bits, same step->draw mapping), so chunking and the
+   32-bit relative-time scheme are covered end to end, not just the per-event
+   kernels.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpusim.backend.pychain import ChainMiner, best_chain, earliest_arrival as chain_earliest
+from tpusim.backend.pychain import run_chain_sim
+from tpusim.config import MinerConfig, NetworkConfig, SimConfig
+from tpusim.engine import Engine
+from tpusim.runner import make_run_keys
+from tpusim.sampling import interval_from_bits, winner_from_bits
+from tpusim.state import TIME_CAP, make_params
+from tpusim.testing import assert_state_matches_chains, drive_state_events
+
+TIME_CAP_I = int(TIME_CAP)
+
+
+def _draw_events(rng, config, n_events, zero_frac=0.0):
+    """Pre-drawn (intervals, winners) with the reference's ns->ms truncation."""
+    mean_ns = config.network.block_interval_s * 1e9
+    intervals = np.rint(rng.exponential(mean_ns, size=n_events)).astype(np.int64) // 1_000_000
+    if zero_frac:
+        zeros = rng.random(n_events) < zero_frac
+        intervals = np.where(zeros, 0, intervals)
+    pcts = np.array([m.hashrate_pct for m in config.network.miners], dtype=np.float64)
+    winners = rng.choice(len(pcts), size=n_events, p=pcts / pcts.sum())
+    return intervals.tolist(), winners.tolist()
+
+
+HONEST_3 = NetworkConfig(
+    miners=(
+        MinerConfig(hashrate_pct=50, propagation_ms=2000),
+        MinerConfig(hashrate_pct=30, propagation_ms=2000),
+        MinerConfig(hashrate_pct=20, propagation_ms=2000),
+    ),
+    block_interval_s=20.0,
+)
+HETERO_4 = NetworkConfig(
+    miners=(
+        MinerConfig(hashrate_pct=40, propagation_ms=5000),
+        MinerConfig(hashrate_pct=30, propagation_ms=100),
+        MinerConfig(hashrate_pct=20, propagation_ms=1500),
+        MinerConfig(hashrate_pct=10, propagation_ms=0),
+    ),
+    block_interval_s=20.0,
+)
+SELFISH_3 = NetworkConfig(
+    miners=(
+        MinerConfig(hashrate_pct=40, propagation_ms=500, selfish=True),
+        MinerConfig(hashrate_pct=35, propagation_ms=500),
+        MinerConfig(hashrate_pct=25, propagation_ms=500),
+    ),
+    block_interval_s=20.0,
+)
+
+
+@pytest.mark.parametrize(
+    "network,mode,zero_frac",
+    [
+        (HONEST_3, "exact", 0.0),
+        (HONEST_3, "exact", 0.15),  # 0 ms interval draws: the while-drain path
+        (HONEST_3, "fast", 0.0),
+        (HETERO_4, "exact", 0.0),
+        (HETERO_4, "fast", 0.0),
+        (SELFISH_3, "exact", 0.0),
+        (SELFISH_3, "exact", 0.1),
+    ],
+)
+@pytest.mark.parametrize("seed", [0, 1])
+def test_event_stream_equivalence(network, mode, zero_frac, seed):
+    config = SimConfig(
+        network=network,
+        duration_ms=1_200_000,  # 20 min at 20 s interval: ~60 blocks, many races
+        runs=1,
+        mode=mode,
+        group_slots=8,
+    )
+    rng = np.random.default_rng(100 * seed + len(network.miners) + int(zero_frac * 100))
+    intervals, winners = _draw_events(rng, config, 400, zero_frac)
+    state, stats = drive_state_events(config, intervals, winners)
+    oracle = run_chain_sim(config, intervals, winners)
+
+    assert np.asarray(stats["blocks_found"]).tolist() == oracle["blocks_found"]
+    assert np.asarray(stats["stale_blocks"]).tolist() == oracle["stale_blocks"]
+    assert int(stats["best_height"]) == oracle["best_height"]
+    np.testing.assert_allclose(stats["blocks_share"], oracle["blocks_share"], rtol=1e-6)
+    np.testing.assert_allclose(stats["stale_rate"], oracle["stale_rate"], rtol=1e-6)
+    assert int(state.overflow) == 0
+
+    if mode == "exact":
+        # Full chain-level state equivalence, not just the stats projection.
+        assert_state_matches_chains(state, oracle["chains"], config.duration_ms, config)
+
+
+def _replay_pychain_with_engine_draws(config: SimConfig, run_idx: int) -> dict:
+    """Host-side replica of Engine.run_batch for ONE run, driving the literal
+    chain model with the exact same threefry draws and step structure
+    (tpusim.engine._step + chunking/re-basing expressed in absolute time)."""
+    params = make_params(config)
+    steps = Engine(config).chunk_steps
+    run_key = make_run_keys(config.seed, run_idx, 1)[0]
+
+    bits0 = jax.random.bits(jax.random.fold_in(run_key, 0), (2,), jnp.uint32)
+    next_block = int(interval_from_bits(bits0[1], params.mean_interval_ms))
+
+    miners = [
+        ChainMiner(idx=i, propagation_ms=mc.propagation_ms, selfish=mc.selfish)
+        for i, mc in enumerate(config.network.miners)
+    ]
+    duration = config.duration_ms
+    t = 0
+    base = 0  # absolute time of the current chunk's origin
+    best_len_prev = 0
+    chunk = 0
+    while duration - base > 0:
+        cap_abs = base + min(duration - base, TIME_CAP_I)
+        key = jax.random.fold_in(run_key, 1 + chunk)
+        bits = np.asarray(jax.random.bits(key, (steps, 2), jnp.uint32))
+        ws = np.asarray(jax.vmap(winner_from_bits, in_axes=(0, None))(bits[:, 0], params.thresholds))
+        dts = np.asarray(
+            jax.vmap(interval_from_bits, in_axes=(0, None))(bits[:, 1], params.mean_interval_ms)
+        )
+        for s in range(steps):
+            if t >= cap_abs:
+                break  # frozen for the rest of this chunk (bits still consumed)
+            found_due = t == next_block
+            if found_due:
+                miners[int(ws[s])].found_block(t, best_len_prev)
+                next_block = t + int(dts[s])
+            if not (found_due and next_block == t):
+                best = best_chain(miners, t)
+                for miner in miners:
+                    miner.notify(best, t)
+                best_len_prev = len(best)
+            arrival = chain_earliest(miners, t)
+            t = max(min(next_block, arrival if arrival is not None else next_block), t)
+        base = t  # rebase: elapsed-this-chunk = t - base_old
+        chunk += 1
+
+    final_best = best_chain(miners, duration)
+    found = [sum(1 for owner, _ in final_best if owner == m.idx) for m in miners]
+    denom = max(len(final_best), 1)
+    return {
+        "blocks_found": found,
+        "blocks_share": [f / denom if f > 0 else 0.0 for f in found],
+        "stale_rate": [m.stale / f if f > 0 else 0.0 for m, f in zip(miners, found)],
+        "stale_blocks": [m.stale for m in miners],
+        "best_height": len(final_best),
+    }
+
+
+@pytest.mark.parametrize(
+    "network,mode",
+    [(HONEST_3, "fast"), (HONEST_3, "exact"), (SELFISH_3, "exact"), (HETERO_4, "fast")],
+)
+def test_engine_matches_pychain_replay(network, mode):
+    runs = 4
+    config = SimConfig(
+        network=network,
+        duration_ms=1_200_000,
+        runs=runs,
+        batch_size=runs,
+        mode=mode,
+        group_slots=8,
+        chunk_steps=48,  # force several chunks so re-basing is on the path
+        seed=13,
+    )
+    engine = Engine(config)
+    sums = engine.run_batch(make_run_keys(config.seed, 0, runs))
+
+    expect = [_replay_pychain_with_engine_draws(config, i) for i in range(runs)]
+    n_m = config.network.n_miners
+    for name, key in [
+        ("blocks_found_sum", "blocks_found"),
+        ("stale_blocks_sum", "stale_blocks"),
+    ]:
+        want = [sum(e[key][i] for e in expect) for i in range(n_m)]
+        assert np.asarray(sums[name]).tolist() == want, name
+    assert int(sums["best_height_sum"]) == sum(e["best_height"] for e in expect)
+    for name, key in [("blocks_share_sum", "blocks_share"), ("stale_rate_sum", "stale_rate")]:
+        want = [sum(e[key][i] for e in expect) for i in range(n_m)]
+        np.testing.assert_allclose(np.asarray(sums[name]), want, rtol=1e-5, err_msg=name)
+    assert int(sums["overflow_sum"]) == 0

@@ -1,0 +1,116 @@
+"""Statistical sampling tests with programmatic tolerances.
+
+Upgrades the reference's print-and-eyeball statistical checks into seeded
+z-tests (the reference prints moments for manual comparison: winner-draw
+binomials at test.cpp:15-63 and test.cpp:68-119, interval moments at
+test.cpp:191-208, a simplified end-to-end share check at test.cpp:122-187).
+Every bound below is a +-5 sigma envelope on a fixed seed, so failures mean a
+real distribution change, not noise (5 sigma two-sided is ~6e-7 per check).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpusim.config import MinerConfig, NetworkConfig, SimConfig
+from tpusim.engine import Engine
+from tpusim.runner import make_run_keys
+from tpusim.sampling import interval_from_bits, winner_from_bits, winner_thresholds32
+
+N_DRAWS = 1_000_000
+SIGMAS = 5.0
+
+
+def _bits(seed: int, n: int) -> jax.Array:
+    return jax.random.bits(jax.random.key(seed), (n,), jnp.uint32)
+
+
+def _winner_counts(pcts: list[int], seed: int) -> np.ndarray:
+    thresholds = jnp.asarray(winner_thresholds32(np.array(pcts)))
+    w = jax.jit(jax.vmap(winner_from_bits, in_axes=(0, None)))(_bits(seed, N_DRAWS), thresholds)
+    return np.bincount(np.asarray(w), minlength=len(pcts))
+
+
+@pytest.mark.parametrize("seed", [0, 7])
+def test_winner_draw_uniform_100x1pct(seed):
+    """100 miners at 1% each: every count is Binomial(N, 0.01)
+    (reference test.cpp:15-63 upgraded from printed moments to a z-test)."""
+    pcts = [1] * 100
+    counts = _winner_counts(pcts, seed)
+    p = 0.01
+    sigma = math.sqrt(N_DRAWS * p * (1 - p))
+    np.testing.assert_array_less(np.abs(counts - N_DRAWS * p), SIGMAS * sigma)
+
+
+@pytest.mark.parametrize("seed", [1, 11])
+def test_winner_draw_heterogeneous(seed):
+    """12/18/20/15/35 split (reference test.cpp:68-119): per-miner z-test."""
+    pcts = [12, 18, 20, 15, 35]
+    counts = _winner_counts(pcts, seed)
+    for c, pct in zip(counts, pcts):
+        p = pct / 100.0
+        sigma = math.sqrt(N_DRAWS * p * (1 - p))
+        assert abs(c - N_DRAWS * p) < SIGMAS * sigma, (c, pct)
+
+
+def test_interval_moments():
+    """floor(Exp(600 s)) in ms: mean ~ sigma ~ 600 000 ms (reference
+    test.cpp:191-208). The floor shifts the mean by ~-0.5 ms, far below the
+    +-5 sigma/sqrt(N) = +-3000 ms envelope; sigma gets a two-sided 5-sigma
+    bound via the fourth-moment standard error sigma^2*sqrt(8/N)."""
+    mean_ms = 600_000.0
+    dts = np.asarray(
+        jax.jit(jax.vmap(interval_from_bits, in_axes=(0, None)))(_bits(3, N_DRAWS), mean_ms),
+        dtype=np.float64,
+    )
+    assert (dts >= 0).all()
+    se_mean = mean_ms / math.sqrt(N_DRAWS)
+    assert abs(dts.mean() - mean_ms) < SIGMAS * se_mean
+    se_var = mean_ms**2 * math.sqrt(8.0 / N_DRAWS)
+    assert abs(dts.var() - mean_ms**2) < SIGMAS * se_var
+
+
+def test_interval_tail_capped():
+    """The 24-bit uniform caps a single draw at ~16.6 means; nothing may reach
+    the int32-envelope clamp at the reference interval (exceedance e^-223)."""
+    mean_ms = 600_000.0
+    dts = np.asarray(jax.vmap(interval_from_bits, in_axes=(0, None))(_bits(4, N_DRAWS), mean_ms))
+    assert dts.max() < 2**27
+
+
+def test_end_to_end_shares_match_hashrates():
+    """Block shares converge to hashrate shares in an honest network — the
+    reference's SimpleSim check (test.cpp:122-187) with a programmatic bound.
+
+    With 1 ms propagation races are ~0, so each run's share vector is a
+    multinomial over ~blocks draws; the cross-run mean-of-shares z-test uses
+    the empirical per-run share variance."""
+    runs = 64
+    config = SimConfig(
+        network=NetworkConfig(
+            miners=(
+                MinerConfig(hashrate_pct=50, propagation_ms=1),
+                MinerConfig(hashrate_pct=30, propagation_ms=1),
+                MinerConfig(hashrate_pct=20, propagation_ms=1),
+            ),
+            block_interval_s=600.0,
+        ),
+        duration_ms=30 * 86_400_000,  # 30 days ~ 4320 blocks/run
+        runs=runs,
+        batch_size=runs,
+        seed=5,
+    )
+    sums = Engine(config).run_batch(make_run_keys(config.seed, 0, runs))
+    share_mean = np.asarray(sums["blocks_share_sum"], dtype=np.float64) / runs
+    blocks = config.duration_ms / (600.0 * 1000.0)
+    for i, m in enumerate(config.network.miners):
+        p = m.hashrate_pct / 100.0
+        se = math.sqrt(p * (1 - p) / blocks / runs)
+        assert abs(share_mean[i] - p) < SIGMAS * se, (i, share_mean[i], p)
+    # Essentially no stale blocks at 1 ms propagation and 600 s intervals.
+    assert np.asarray(sums["stale_rate_sum"]).sum() / runs < 1e-3

@@ -58,7 +58,7 @@ from .state import (
     rebase,
 )
 
-__all__ = ["Engine", "default_n_steps", "make_engine"]
+__all__ = ["Engine", "default_n_steps"]
 
 #: Per-batch int32 block-count sums stay exact below this many blocks.
 _I32_SUM_GUARD = 2**31 - 1
@@ -123,7 +123,13 @@ class Engine:
         self.n_miners = config.network.n_miners
         self.exact = config.resolved_mode == "exact"
         bound = default_n_steps(config.duration_ms, config.network.block_interval_s)
-        self.chunk_steps = min(config.chunk_steps or 2048, bound)
+        # A run freezes at TIME_CAP within a chunk regardless of steps left, so
+        # a chunk larger than one TIME_CAP span's event bound only burns scan
+        # steps on frozen runs; size the default to that span (~957 steps at
+        # the 600 s reference interval).
+        cap_bound = default_n_steps(min(int(TIME_CAP), config.duration_ms),
+                                    config.network.block_interval_s)
+        self.chunk_steps = min(config.chunk_steps or cap_bound, bound)
         # Host-loop safety margin: generous vs the per-run 8-sigma bound
         # because the loop must cover the batch *max* event count; the second
         # term covers runs that freeze at TIME_CAP and re-base repeatedly.
@@ -242,7 +248,3 @@ class Engine:
         out = {k: np.asarray(v) for k, v in sums.items()}
         out["runs"] = np.int64(n)
         return out
-
-
-def make_engine(config: SimConfig, mesh: Mesh | None = None) -> Engine:
-    return Engine(config, mesh)

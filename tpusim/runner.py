@@ -130,6 +130,8 @@ def run_simulation_config(
             try:
                 batch_sums = this_engine.run_batch(keys)
                 break
+            except (ValueError, TypeError):
+                raise  # deterministic config errors are not transient; no retry
             except Exception:  # noqa: BLE001 — batch-level retry is the point
                 if attempt == max_retries:
                     raise
