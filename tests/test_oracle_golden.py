@@ -1,0 +1,144 @@
+"""Integration checks against the two independent oracles the reference ships:
+
+* the closed-form analytical stale-rate model (reference
+  plot_stale_rate/plot.py:18-77, ported as tpusim.analysis.oracle) for
+  honest-only configurations across a propagation sweep, and
+* the reference README's golden result tables (reference README.md:51-107),
+  which function as the project's de-facto golden integration outputs — the
+  10 s / 100 ms honest tables and the 40 %-selfish table.
+
+Run counts here are far below the reference's 32768 (CI time), so tolerances
+are Monte-Carlo envelopes around the analytical/golden values, not the
+±1e-4 production cross-validation bound (that bound is about backend
+agreement at equal sample sizes, covered by test_state_equivalence.py).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+from tpusim.analysis.oracle import analytical_net_benefits, analytical_stale_rates
+from tpusim.config import SimConfig, default_network
+from tpusim.engine import Engine
+from tpusim.runner import make_run_keys
+
+HASHRATES = (30, 29, 12, 11, 8, 5, 3, 1, 1)
+
+
+def _run(config: SimConfig) -> dict[str, np.ndarray]:
+    sums = Engine(config).run_batch(make_run_keys(config.seed, 0, config.runs))
+    return {k: np.asarray(v) for k, v in sums.items()}
+
+
+def _stale_tolerance(p: float, blocks_per_run: float, runs: int, hashrate: float) -> float:
+    """5-sigma MC envelope on a mean of per-run stale ratios plus 10% relative
+    slack for the oracle's neglected higher-order race terms."""
+    own_blocks = max(blocks_per_run * hashrate, 1.0)
+    sigma = math.sqrt(max(p, 1e-12) / own_blocks / runs)
+    return 5.0 * sigma + 0.10 * p
+
+
+@pytest.mark.parametrize("prop_ms", [1000, 10_000])
+def test_honest_stale_rates_match_analytical_oracle(prop_ms):
+    runs, days = 64, 45
+    config = SimConfig(
+        network=default_network(propagation_ms=prop_ms),
+        duration_ms=days * 86_400_000,
+        runs=runs,
+        batch_size=runs,
+        seed=17,
+    )
+    sums = _run(config)
+    stale = sums["stale_rate_sum"] / runs
+    hashrates = [h / 100.0 for h in HASHRATES]
+    oracle = analytical_stale_rates(hashrates, prop_ms / 1000.0)
+    blocks_per_run = config.duration_ms / 600_000.0
+    for i, (got, want) in enumerate(zip(stale, oracle)):
+        tol = _stale_tolerance(want, blocks_per_run, runs, hashrates[i])
+        assert abs(got - want) < tol, (i, got, want, tol)
+
+
+def test_golden_table_10s_propagation():
+    """Reference README.md:51-66: miner-0 stale ~1.01%, miner-8 ~2.0%."""
+    runs, days = 64, 45
+    config = SimConfig(
+        network=default_network(propagation_ms=10_000),
+        duration_ms=days * 86_400_000,
+        runs=runs,
+        batch_size=runs,
+        seed=23,
+    )
+    sums = _run(config)
+    stale = sums["stale_rate_sum"] / runs
+    share = sums["blocks_share_sum"] / runs
+    blocks_per_run = config.duration_ms / 600_000.0
+    assert abs(stale[0] - 0.0101) < _stale_tolerance(0.0101, blocks_per_run, runs, 0.30)
+    assert abs(stale[8] - 0.0200) < _stale_tolerance(0.0200, blocks_per_run, runs, 0.01)
+    # Shares stay within 5 sigma of hashrate (propagation losses cancel in the
+    # share because every miner loses proportionally; README table col 2).
+    for i, h in enumerate(HASHRATES):
+        p = h / 100.0
+        se = math.sqrt(p * (1 - p) / blocks_per_run / runs)
+        assert abs(share[i] - p) < 5 * se + 0.01 * p, (i, share[i], p)
+
+
+def test_golden_table_100ms_propagation():
+    """Reference README.md:68-87: miner-0 stale ~0.0102%, miner-8 ~0.0205%.
+
+    Rates this small need large samples; with the 5-sigma envelope this is a
+    magnitude check (no stale-rate inflation, correct ~100x drop vs 10 s)."""
+    runs, days = 96, 45
+    config = SimConfig(
+        network=default_network(propagation_ms=100),
+        duration_ms=days * 86_400_000,
+        runs=runs,
+        batch_size=runs,
+        seed=29,
+    )
+    sums = _run(config)
+    stale = sums["stale_rate_sum"] / runs
+    blocks_per_run = config.duration_ms / 600_000.0
+    assert abs(stale[0] - 0.000102) < _stale_tolerance(0.000102, blocks_per_run, runs, 0.30)
+    assert stale.max() < 0.0015  # two orders below the 10 s table across the board
+
+
+def test_golden_table_selfish_40pct():
+    """Reference README.md:89-107: a 40% gamma=0 selfish miner earns ~46.7% of
+    blocks (~+16% revenue), its stale rate ~27.5%, honest miners' ~67.5%."""
+    runs, days = 32, 90
+    config = SimConfig(
+        network=default_network(
+            propagation_ms=1000,
+            selfish_ids=(0,),
+            hashrates=(40, 19, 12, 11, 8, 5, 3, 1, 1),
+        ),
+        duration_ms=days * 86_400_000,
+        runs=runs,
+        batch_size=runs,
+        seed=31,
+    )
+    sums = _run(config)
+    share = sums["blocks_share_sum"] / runs
+    stale = sums["stale_rate_sum"] / runs
+    # Best-chain growth halves during duels; per-run share variance is wide at
+    # 32 runs, so use generous 5-sigma-ish windows around the README values.
+    assert abs(share[0] - 0.467) < 0.02, share[0]
+    assert abs(stale[0] - 0.275) < 0.03, stale[0]
+    honest = stale[1:]
+    assert abs(honest.mean() - 0.675) < 0.03, honest
+    assert share[1:].sum() < 0.55
+
+
+def test_analytical_net_benefits_sign_structure():
+    """Large miners gain from slow propagation relative to small ones once
+    difficulty retargets (reference plot.py:58-77): benefits are monotone
+    non-increasing in hashrate order and the largest miner's is positive."""
+    hashrates = [h / 100.0 for h in HASHRATES]
+    ben = analytical_net_benefits(hashrates, 10.0)
+    assert ben[0] > 0
+    assert ben[0] > ben[-1]
+    # Equal-hashrate miners see equal benefit.
+    assert math.isclose(ben[7], ben[8], rel_tol=1e-12)

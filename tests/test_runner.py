@@ -1,0 +1,185 @@
+"""Runner, sharding, checkpoint, CLI, and sampling-primitive unit tests.
+
+The multi-device cases run on the 8 virtual CPU devices conftest.py forces, so
+the shard_map/psum path of the engine (the reference's run-level parallelism,
+main.cpp:195-220, re-expressed over a device mesh) is exercised in every CI
+run, not only by the driver's separate dry-run entry point.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh
+
+from tpusim.cli import main as cli_main
+from tpusim.config import MinerConfig, NetworkConfig, SimConfig, default_network
+from tpusim.engine import Engine
+from tpusim.runner import make_run_keys, run_simulation_config
+from tpusim.sampling import (
+    PERC_MULTIPLIER32,
+    interval_from_bits,
+    winner_from_bits,
+    winner_thresholds,
+    winner_thresholds32,
+)
+
+SMALL = SimConfig(
+    network=default_network(propagation_ms=5000),
+    duration_ms=3 * 86_400_000,
+    runs=16,
+    batch_size=16,
+    seed=3,
+)
+
+
+def test_sharded_matches_single_device():
+    keys = make_run_keys(SMALL.seed, 0, SMALL.runs)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("runs",))
+    sharded = Engine(SMALL, mesh).run_batch(keys)
+    single = Engine(SMALL, None).run_batch(keys)
+    for name in single:
+        np.testing.assert_allclose(
+            np.asarray(sharded[name]), np.asarray(single[name]), rtol=1e-6, err_msg=name
+        )
+
+
+def test_runner_remainder_batch_not_divisible_by_mesh():
+    """runs % n_devices != 0: the trailing remainder runs unsharded, and the
+    result equals a single-device run of the same config."""
+    config = dataclasses.replace(SMALL, runs=20, batch_size=8)
+    res_multi = run_simulation_config(config, use_all_devices=True)
+    res_single = run_simulation_config(config, use_all_devices=False)
+    assert res_multi.runs == res_single.runs == 20
+    for a, b in zip(res_multi.miners, res_single.miners):
+        assert a.blocks_found_mean == b.blocks_found_mean
+        np.testing.assert_allclose(a.stale_rate_mean, b.stale_rate_mean, rtol=1e-6)
+
+
+def test_checkpoint_resume_extends_sweep(tmp_path):
+    """A checkpointed 16-run sweep extended to 32 runs equals a fresh 32-run
+    sweep batch for batch (keys are global-run-indexed; sums are additive)."""
+    ck = tmp_path / "ck.npz"
+    cfg16 = dataclasses.replace(SMALL, runs=16, batch_size=8)
+    cfg32 = dataclasses.replace(SMALL, runs=32, batch_size=8)
+    run_simulation_config(cfg16, use_all_devices=False, checkpoint_path=ck)
+    resumed = run_simulation_config(cfg32, use_all_devices=False, checkpoint_path=ck)
+    fresh = run_simulation_config(cfg32, use_all_devices=False)
+    assert resumed.runs == fresh.runs == 32
+    for a, b in zip(resumed.miners, fresh.miners):
+        assert a.blocks_found_mean == b.blocks_found_mean
+        assert a.stale_blocks_mean == b.stale_blocks_mean
+        np.testing.assert_allclose(a.blocks_share_mean, b.blocks_share_mean, rtol=0, atol=1e-12)
+
+
+def test_checkpoint_rejects_different_config(tmp_path):
+    ck = tmp_path / "ck.npz"
+    run_simulation_config(SMALL, use_all_devices=False, checkpoint_path=ck)
+    other = dataclasses.replace(SMALL, duration_ms=86_400_000)
+    with pytest.raises(ValueError, match="different config"):
+        run_simulation_config(other, use_all_devices=False, checkpoint_path=ck)
+
+
+def test_checkpoint_allows_rebatching(tmp_path):
+    """batch_size and runs are excluded from the fingerprint by design."""
+    ck = tmp_path / "ck.npz"
+    run_simulation_config(SMALL, use_all_devices=False, checkpoint_path=ck)
+    rebatched = dataclasses.replace(SMALL, runs=24, batch_size=4)
+    res = run_simulation_config(rebatched, use_all_devices=False, checkpoint_path=ck)
+    assert res.runs == 24
+
+
+# --- CLI ------------------------------------------------------------------
+
+
+def test_cli_table_format(tmp_path, capsys):
+    out_json = tmp_path / "out.json"
+    rc = cli_main(
+        [
+            "--runs", "4", "--days", "2", "--propagation-ms", "1000",
+            "--batch-size", "4", "--quiet", "--single-device",
+            "--json", str(out_json),
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "After running 4 simulations for 2d each, on average:" in out
+    # Canonical per-miner line (reference main.cpp:227-234).
+    assert re.search(
+        r"  - Miner 0 \(30% of network hashrate\) found \d+ blocks "
+        r"i\.e\. [\d.]+% of blocks\. Stale rate: [\d.e-]+%\.",
+        out,
+    ), out
+    data = json.loads(out_json.read_text())
+    assert data["runs"] == 4 and len(data["miners"]) == 9
+
+
+def test_cli_selfish_flag_marks_miner(capsys):
+    rc = cli_main(
+        [
+            "--runs", "2", "--days", "2", "--hashrates", "40,60", "--selfish", "0",
+            "--batch-size", "2", "--quiet", "--single-device",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "('selfish mining' strategy)" in out
+    assert out.count("selfish mining") == 1
+
+
+def test_cli_rejects_bad_hashrates():
+    with pytest.raises(SystemExit):
+        cli_main(["--hashrates", "50,49"])  # sums to 99
+
+
+def test_cli_config_file_roundtrip(tmp_path, capsys):
+    cfg = dataclasses.replace(SMALL, runs=2, duration_ms=86_400_000)
+    path = tmp_path / "cfg.json"
+    path.write_text(cfg.to_json())
+    rc = cli_main(["--config", str(path), "--quiet", "--single-device"])
+    assert rc == 0
+    assert "After running 2 simulations" in capsys.readouterr().out
+
+
+# --- sampling primitives ---------------------------------------------------
+
+
+def test_winner_thresholds_u64_exact():
+    t = winner_thresholds(np.array([30, 29, 12, 11, 8, 5, 3, 1, 1]))
+    assert t.dtype == np.uint64
+    assert int(t[-1]) == 100 * ((2**64 - 1) // 100)
+    assert (np.diff(t.astype(object)) > 0).all()
+
+
+def test_winner_from_bits_boundaries():
+    thresholds = jnp.asarray(winner_thresholds32(np.array([50, 50])))
+    assert int(winner_from_bits(jnp.uint32(0), thresholds)) == 0
+    assert int(winner_from_bits(jnp.uint32(50 * PERC_MULTIPLIER32 - 1), thresholds)) == 0
+    assert int(winner_from_bits(jnp.uint32(50 * PERC_MULTIPLIER32), thresholds)) == 1
+    # Draws past the 100% threshold clamp to the last miner (the reference
+    # asserts instead, simulation.h:220).
+    assert int(winner_from_bits(jnp.uint32(2**32 - 1), thresholds)) == 1
+
+
+def test_interval_from_bits_zero_and_positive():
+    assert int(interval_from_bits(jnp.uint32(0), 600_000.0)) == 0
+    assert int(interval_from_bits(jnp.uint32(2**32 - 1), 600_000.0)) > 0
+
+
+def test_config_validation_errors():
+    with pytest.raises(ValueError, match="sum to 100"):
+        NetworkConfig(miners=(MinerConfig(hashrate_pct=50),))
+    with pytest.raises(ValueError, match="hashrate_pct"):
+        MinerConfig(hashrate_pct=101)
+    with pytest.raises(ValueError, match="int32 time envelope"):
+        SimConfig(
+            network=NetworkConfig(
+                miners=(MinerConfig(hashrate_pct=100),), block_interval_s=7200.0
+            )
+        )

@@ -119,7 +119,8 @@ def _replay_pychain_with_engine_draws(config: SimConfig, run_idx: int) -> dict:
     chain model with the exact same threefry draws and step structure
     (tpusim.engine._step + chunking/re-basing expressed in absolute time)."""
     params = make_params(config)
-    steps = Engine(config).chunk_steps
+    steps = config.chunk_steps
+    assert steps is not None, "replay tests must pin chunk_steps in the config"
     run_key = make_run_keys(config.seed, run_idx, 1)[0]
 
     bits0 = jax.random.bits(jax.random.fold_in(run_key, 0), (2,), jnp.uint32)

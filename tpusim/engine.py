@@ -125,11 +125,12 @@ class Engine:
         bound = default_n_steps(config.duration_ms, config.network.block_interval_s)
         # A run freezes at TIME_CAP within a chunk regardless of steps left, so
         # a chunk larger than one TIME_CAP span's event bound only burns scan
-        # steps on frozen runs; size the default to that span (~957 steps at
-        # the 600 s reference interval).
+        # steps on frozen runs; size the default to that span (~1249 steps at
+        # the 600 s reference interval), clamped to 2048 so short-interval
+        # configs don't materialize huge per-chunk RNG buffers.
         cap_bound = default_n_steps(min(int(TIME_CAP), config.duration_ms),
                                     config.network.block_interval_s)
-        self.chunk_steps = min(config.chunk_steps or cap_bound, bound)
+        self.chunk_steps = min(config.chunk_steps or min(cap_bound, 2048), bound)
         # Host-loop safety margin: generous vs the per-run 8-sigma bound
         # because the loop must cover the batch *max* event count; the second
         # term covers runs that freeze at TIME_CAP and re-base repeatedly.

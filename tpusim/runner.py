@@ -106,6 +106,10 @@ def run_simulation_config(
     fp_dict = json.loads(config.to_json())
     fp_dict.pop("runs", None)
     fp_dict.pop("batch_size", None)
+    # chunk_steps=None resolves to an engine-chosen default that may change
+    # between versions; fingerprint the *resolved* value, which is what fixes
+    # the step->key sampling identity.
+    fp_dict["chunk_steps"] = engine.chunk_steps
     fingerprint = json.dumps(fp_dict, sort_keys=True)
     ckpt = _Checkpoint(Path(checkpoint_path), fingerprint) if checkpoint_path else None
     runs_done, sums = 0, None
