@@ -1,0 +1,342 @@
+// simcore — native C++20 backend for tpusim.
+//
+// An independent re-implementation of the mining-simulation semantics the
+// framework targets (behavioral contract documented in SURVEY.md §2.1 against
+// reference simulation.h / main.cpp), exposed through a C ABI for ctypes.
+// It exists as the performance-credible cross-validation oracle for the JAX
+// engine and as the native equivalent of the reference's std::async runner
+// (reference main.cpp:195-220).
+//
+// Design differences from the reference (deliberate; this is not a port):
+//   * the genesis block is implicit — a chain is a vector of post-genesis
+//     blocks, and an empty published chain has tip arrival 0;
+//   * times are int64 milliseconds; a private (unrevealed selfish) block is
+//     marked with arrival = kPrivate (-1) instead of milliseconds::max;
+//   * every run is seeded deterministically from (seed, run_index), so results
+//     are reproducible and independent of thread count (the reference seeds
+//     from std::random_device, reference main.cpp:131-134);
+//   * runs are statically partitioned over threads and written to per-run
+//     slots, then reduced sequentially — bitwise-identical totals for any
+//     thread count.
+//
+// Sampling keeps the reference's exact pipelines (SURVEY.md §2.1): exponential
+// intervals drawn in nanoseconds, llround'ed, truncated to ms; winner draws
+// against cumulative uint64 thresholds pct * ((2^64-1)/100).
+
+#include <cassert>
+#include <cmath>
+#include <cstdint>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// RNG: xoroshiro128++ (Blackman & Vigna, public domain algorithm), seeded
+// with two successive splitmix64 outputs.
+// ---------------------------------------------------------------------------
+
+inline uint64_t splitmix64(uint64_t& x) {
+  x += 0x9e3779b97f4a7c15ull;
+  uint64_t z = x;
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+  return z ^ (z >> 31);
+}
+
+inline uint64_t rotl64(uint64_t v, int k) { return (v << k) | (v >> (64 - k)); }
+
+class Xoro {
+ public:
+  explicit Xoro(uint64_t seed) {
+    a_ = splitmix64(seed);
+    b_ = splitmix64(seed);
+  }
+
+  uint64_t next() {
+    const uint64_t s0 = a_;
+    uint64_t s1 = b_;
+    const uint64_t out = rotl64(s0 + s1, 17) + s0;
+    s1 ^= s0;
+    a_ = rotl64(s0, 49) ^ s1 ^ (s1 << 21);
+    b_ = rotl64(s1, 28);
+    return out;
+  }
+
+  // Exponential with the given mean: inverse CDF on the top 53 bits.
+  double expo(double mean) {
+    const double u = static_cast<double>(next() >> 11) * 0x1.0p-53;
+    return -std::log1p(-u) * mean;
+  }
+
+ private:
+  uint64_t a_, b_;
+};
+
+// ---------------------------------------------------------------------------
+// Domain model.
+// ---------------------------------------------------------------------------
+
+constexpr int64_t kPrivate = -1;  // arrival sentinel for unrevealed blocks
+constexpr uint64_t kPctMult = ~0ull / 100u;  // percent -> uint64 threshold step
+
+struct Bk {
+  int32_t owner;
+  int64_t arrival;  // absolute ms at which everyone else has it; kPrivate if secret
+  bool operator==(const Bk&) const = default;
+};
+
+// Non-owning view of a published chain prefix. Valid for one notify sweep:
+// the published prefix it points into cannot change during the sweep (reveals
+// only stamp private blocks above it, reorgs only mutate *other* miners'
+// chains, and the best-chain owner never reorgs onto itself).
+struct BestView {
+  const Bk* blocks;
+  size_t len;
+  const Bk& operator[](size_t i) const { return blocks[i]; }
+};
+
+struct MinerCfg {
+  int32_t pct;
+  int64_t prop_ms;
+  bool selfish;
+};
+
+struct MinerRun {
+  int32_t idx;
+  int64_t prop_ms;
+  bool selfish;
+  std::vector<Bk> chain;  // post-genesis blocks only
+  int64_t stale = 0;
+
+  // Trailing private-suffix length (the paper's privateBranchLen).
+  int private_len() const {
+    int n = 0;
+    for (auto it = chain.rbegin(); it != chain.rend() && it->arrival == kPrivate; ++it) ++n;
+    return n;
+  }
+
+  // Number of trailing blocks nobody else has at time t (private or in flight).
+  int unpublished(int64_t t) const {
+    int n = 0;
+    for (auto it = chain.rbegin(); it != chain.rend(); ++it) {
+      if (it->arrival != kPrivate && it->arrival <= t) break;
+      ++n;
+    }
+    return n;
+  }
+
+  // Arrival of the oldest in-flight published block strictly after t, or -1.
+  int64_t next_arrival(int64_t t) const {
+    int64_t earliest = -1;
+    for (auto it = chain.rbegin(); it != chain.rend(); ++it) {
+      if (it->arrival == kPrivate) continue;  // secret blocks never arrive
+      if (it->arrival <= t) break;
+      earliest = it->arrival;  // reverse scan: last overwrite = oldest block
+    }
+    return earliest;
+  }
+
+  // A new block of ours at time t. best_len = current best published length
+  // (post-genesis count) captured after the previous notify sweep.
+  void found_block(int64_t t, size_t best_len) {
+    if (selfish) {
+      // Winning a 1-block race: exactly one secret block and the public best
+      // matched our length — publish the secret block and the new one.
+      if (private_len() == 1 && best_len == chain.size()) {
+        chain.back().arrival = t + prop_ms;
+        chain.push_back({idx, t + prop_ms});
+      } else {
+        chain.push_back({idx, kPrivate});
+      }
+    } else {
+      chain.push_back({idx, t + prop_ms});
+    }
+  }
+
+  // Gamma=0 selective reveal: once the public chain catches up, publish just
+  // enough of the oldest secret blocks — all of them when the lead collapses
+  // to 1 with more than one secret block in hand.
+  void maybe_reveal(const BestView& best, int64_t t) {
+    if (!selfish || best.len > chain.size()) return;
+    const int secret = private_len();
+    const int lead = static_cast<int>(chain.size() - best.len);
+    if (secret <= lead) return;
+    const int reveal = (secret > 1 && lead == 1) ? secret : secret - lead;
+    const size_t first = chain.size() - static_cast<size_t>(secret);
+    for (size_t i = first; i < first + static_cast<size_t>(reveal); ++i)
+      chain[i].arrival = t + prop_ms;
+  }
+
+  // Longest-chain reorg; every popped own block counts as stale.
+  void maybe_reorg(const BestView& best) {
+    if (best.len <= chain.size()) return;
+    while (!chain.empty() && chain.back() != best[chain.size() - 1]) {
+      if (chain.back().owner == idx) ++stale;
+      chain.pop_back();
+    }
+    chain.insert(chain.end(), best.blocks + chain.size(), best.blocks + best.len);
+  }
+
+  void notify(const BestView& best, int64_t t) {
+    maybe_reveal(best, t);  // reveal before reorg; order matters
+    maybe_reorg(best);
+  }
+};
+
+// Longest published chain across miners; ties go to the earlier tip arrival,
+// then to roster order (the first-seen rule). Returns a view, not a copy —
+// the dominant cost of the event loop would otherwise be copying a ~52k-block
+// vector twice per block event.
+BestView best_published(const std::vector<MinerRun>& miners, int64_t t) {
+  const MinerRun* who = nullptr;
+  size_t best_len = 0;
+  int64_t best_tip = 0;
+  for (const auto& m : miners) {
+    const size_t len = m.chain.size() - static_cast<size_t>(m.unpublished(t));
+    const int64_t tip = len == 0 ? 0 : m.chain[len - 1].arrival;
+    if (!who || len > best_len || (len == best_len && tip < best_tip)) {
+      who = &m;
+      best_len = len;
+      best_tip = tip;
+    }
+  }
+  return {who->chain.data(), best_len};
+}
+
+int64_t earliest_pending(const std::vector<MinerRun>& miners, int64_t t) {
+  int64_t earliest = -1;
+  for (const auto& m : miners) {
+    const int64_t a = m.next_arrival(t);
+    if (a >= 0 && (earliest < 0 || a < earliest)) earliest = a;
+  }
+  return earliest;
+}
+
+struct RunOut {
+  std::vector<double> found, share, stale_rate, stale_blocks;
+  double best_height = 0;
+};
+
+// One full Monte-Carlo run: event-driven loop with cut-through time advance.
+RunOut simulate_run(const std::vector<MinerCfg>& cfg, int64_t duration_ms,
+                    double interval_ns_mean, const std::vector<uint64_t>& thresholds,
+                    uint64_t seed, int64_t run_idx) {
+  uint64_t mix = seed;
+  (void)splitmix64(mix);  // decorrelate from the Python key schedule trivially
+  Xoro interval_rng(mix ^ (0x517cc1b727220a95ull * static_cast<uint64_t>(2 * run_idx + 1)));
+  Xoro winner_rng(mix ^ (0x517cc1b727220a95ull * static_cast<uint64_t>(2 * run_idx + 2)));
+
+  std::vector<MinerRun> miners;
+  miners.reserve(cfg.size());
+  for (size_t i = 0; i < cfg.size(); ++i)
+    miners.push_back({static_cast<int32_t>(i), cfg[i].prop_ms, cfg[i].selfish, {}, 0});
+
+  auto draw_interval = [&]() -> int64_t {
+    return std::llround(interval_rng.expo(interval_ns_mean)) / 1'000'000;
+  };
+  auto draw_winner = [&]() -> size_t {
+    const uint64_t r = winner_rng.next();
+    for (size_t i = 0; i < thresholds.size(); ++i)
+      if (thresholds[i] > r) return i;
+    return thresholds.size() - 1;  // ~16/2^64 of draws land past 100%
+  };
+
+  int64_t t = 0;
+  int64_t next_block = draw_interval();
+  size_t best_len = 0;  // post-genesis length after the last notify sweep
+  while (t < duration_ms) {
+    while (t == next_block) {
+      miners[draw_winner()].found_block(t, best_len);
+      next_block = t + draw_interval();
+    }
+    const BestView best = best_published(miners, t);
+    for (auto& m : miners) m.notify(best, t);
+    best_len = best.len;
+    const int64_t arrival = earliest_pending(miners, t);
+    t = arrival < 0 ? next_block : std::min(next_block, arrival);
+  }
+
+  // Final stats vs the best chain at the configured end time.
+  const BestView final_best = best_published(miners, duration_ms);
+  const auto denom = static_cast<double>(std::max<size_t>(final_best.len, 1));
+  RunOut out;
+  out.best_height = static_cast<double>(final_best.len);
+  for (const auto& m : miners) {
+    int64_t mine = 0;
+    for (size_t b = 0; b < final_best.len; ++b) mine += final_best[b].owner == m.idx;
+    out.found.push_back(static_cast<double>(mine));
+    out.share.push_back(mine > 0 ? static_cast<double>(mine) / denom : 0.0);
+    out.stale_rate.push_back(mine > 0 ? static_cast<double>(m.stale) / static_cast<double>(mine)
+                                      : 0.0);
+    out.stale_blocks.push_back(static_cast<double>(m.stale));
+  }
+  return out;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI.
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+// Runs `runs` independent simulations over `threads` OS threads and writes
+// per-miner sums of (found, share, stale_rate, stale_blocks) plus the summed
+// best-chain height. Sums are per-run statistics added in run order, matching
+// the mean-of-per-run-ratios aggregation the framework reports. Returns 0 on
+// success, nonzero on invalid arguments.
+int simcore_run(int32_t n_miners, const int32_t* hashrate_pct, const int64_t* prop_ms,
+                const uint8_t* selfish, int64_t duration_ms, double block_interval_s,
+                int64_t runs, uint64_t seed, int32_t threads, double* found_sum,
+                double* share_sum, double* stale_rate_sum, double* stale_blocks_sum,
+                double* best_height_sum) {
+  if (n_miners <= 0 || runs <= 0 || duration_ms <= 0 || block_interval_s <= 0) return 1;
+  std::vector<MinerCfg> cfg;
+  std::vector<uint64_t> thresholds;
+  uint64_t acc = 0;
+  int64_t pct_total = 0;
+  for (int32_t i = 0; i < n_miners; ++i) {
+    cfg.push_back({hashrate_pct[i], prop_ms[i], selfish[i] != 0});
+    pct_total += hashrate_pct[i];
+    acc += static_cast<uint64_t>(hashrate_pct[i]) * kPctMult;
+    thresholds.push_back(acc);
+  }
+  if (pct_total != 100) return 2;
+
+  const double interval_ns_mean = block_interval_s * 1e9;
+  const int nthreads =
+      std::max(1, threads > 0 ? threads : static_cast<int>(std::thread::hardware_concurrency()));
+
+  std::vector<RunOut> per_run(static_cast<size_t>(runs));
+  auto worker = [&](int tid) {
+    for (int64_t r = tid; r < runs; r += nthreads)
+      per_run[static_cast<size_t>(r)] =
+          simulate_run(cfg, duration_ms, interval_ns_mean, thresholds, seed, r);
+  };
+  if (nthreads == 1) {
+    worker(0);
+  } else {
+    std::vector<std::thread> pool;
+    pool.reserve(static_cast<size_t>(nthreads));
+    for (int tid = 0; tid < nthreads; ++tid) pool.emplace_back(worker, tid);
+    for (auto& th : pool) th.join();
+  }
+
+  for (int32_t i = 0; i < n_miners; ++i)
+    found_sum[i] = share_sum[i] = stale_rate_sum[i] = stale_blocks_sum[i] = 0.0;
+  *best_height_sum = 0.0;
+  for (const auto& r : per_run) {  // sequential, run-order reduction
+    for (int32_t i = 0; i < n_miners; ++i) {
+      found_sum[i] += r.found[static_cast<size_t>(i)];
+      share_sum[i] += r.share[static_cast<size_t>(i)];
+      stale_rate_sum[i] += r.stale_rate[static_cast<size_t>(i)];
+      stale_blocks_sum[i] += r.stale_blocks[static_cast<size_t>(i)];
+    }
+    *best_height_sum += r.best_height;
+  }
+  return 0;
+}
+
+}  // extern "C"

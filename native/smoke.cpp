@@ -1,0 +1,64 @@
+// Sanitized smoke test for simcore: runs a small honest and a selfish batch
+// under ASan/UBSan (make check) and applies coarse sanity bounds. The real
+// behavioral validation happens from Python (tests/test_cpp_backend.py),
+// cross-checked against the JAX engine and the analytical oracle.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+
+extern "C" int simcore_run(int32_t, const int32_t*, const int64_t*, const uint8_t*, int64_t,
+                           double, int64_t, uint64_t, int32_t, double*, double*, double*,
+                           double*, double*);
+
+static void expect(bool ok, const char* what) {
+  if (!ok) {
+    std::fprintf(stderr, "smoke FAILED: %s\n", what);
+    std::exit(1);
+  }
+}
+
+int main() {
+  {
+    // Honest 9-miner network, 10 s propagation, 8 runs x 30 days, 4 threads.
+    const std::vector<int32_t> pct = {30, 29, 12, 11, 8, 5, 3, 1, 1};
+    const std::vector<int64_t> prop(9, 10'000);
+    const std::vector<uint8_t> selfish(9, 0);
+    std::vector<double> found(9), share(9), rate(9), stale(9);
+    double best = 0;
+    const int rc = simcore_run(9, pct.data(), prop.data(), selfish.data(),
+                               30ll * 86'400'000, 600.0, 8, 42, 4, found.data(),
+                               share.data(), rate.data(), stale.data(), &best);
+    expect(rc == 0, "honest run rc");
+    expect(best / 8 > 3800 && best / 8 < 4900, "mean best height ~4320");
+    expect(share[0] / 8 > 0.25 && share[0] / 8 < 0.35, "miner-0 share ~30%");
+    expect(rate[0] / 8 < 0.05, "miner-0 stale rate small");
+  }
+  {
+    // 40% selfish miner: share must exceed hashrate, honest stale rates high.
+    const std::vector<int32_t> pct = {40, 19, 12, 11, 8, 5, 3, 1, 1};
+    const std::vector<int64_t> prop(9, 1'000);
+    std::vector<uint8_t> selfish(9, 0);
+    selfish[0] = 1;
+    std::vector<double> found(9), share(9), rate(9), stale(9);
+    double best = 0;
+    const int rc = simcore_run(9, pct.data(), prop.data(), selfish.data(),
+                               60ll * 86'400'000, 600.0, 6, 7, 3, found.data(),
+                               share.data(), rate.data(), stale.data(), &best);
+    expect(rc == 0, "selfish run rc");
+    expect(share[0] / 6 > 0.40, "selfish share above hashrate");
+    expect(rate[1] / 6 > 0.5, "honest stale rate high under selfish attack");
+  }
+  {
+    // Bad config: percentages not summing to 100 must be rejected.
+    const int32_t pct[2] = {50, 49};
+    const int64_t prop[2] = {1000, 1000};
+    const uint8_t selfish[2] = {0, 0};
+    double f[2], s[2], r[2], st[2], b;
+    expect(simcore_run(2, pct, prop, selfish, 1000, 600.0, 1, 0, 1, f, s, r, st, &b) == 2,
+           "pct sum validation");
+  }
+  std::puts("smoke ok");
+  return 0;
+}

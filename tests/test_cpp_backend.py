@@ -1,0 +1,117 @@
+"""Native C++ backend: build, determinism, and cross-validation vs the JAX
+engine and the reference golden values.
+
+This is the framework's two-backend check (the SimBackend boundary): one
+config, two independent implementations — the JAX O(1)-automaton engine and
+the native materialized-chain simulator — must agree within Monte-Carlo
+tolerance. The reference has no such harness; its README tables play this
+role manually (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import math
+import shutil
+
+import numpy as np
+import pytest
+
+from tpusim.config import SimConfig, default_network
+from tpusim.engine import Engine
+from tpusim.runner import make_run_keys
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None or shutil.which("make") is None,
+    reason="native toolchain unavailable",
+)
+
+
+@pytest.fixture(scope="module")
+def cpp_run():
+    from tpusim.backend.cpp import NativeBuildError, run_simulation_cpp
+
+    try:
+        probe = SimConfig(
+            network=default_network(), duration_ms=3_600_000, runs=1, batch_size=1
+        )
+        run_simulation_cpp(probe, threads=1)
+    except NativeBuildError as e:  # pragma: no cover - toolchain-specific
+        pytest.skip(f"native build failed: {e}")
+    return run_simulation_cpp
+
+
+HONEST_10S = SimConfig(
+    network=default_network(propagation_ms=10_000),
+    duration_ms=30 * 86_400_000,
+    runs=256,
+    seed=11,
+)
+
+
+def test_deterministic_and_thread_invariant(cpp_run):
+    a = cpp_run(HONEST_10S, threads=1)
+    b = cpp_run(HONEST_10S, threads=1)
+    c = cpp_run(HONEST_10S, threads=4)
+    for x, y, z in zip(a.miners, b.miners, c.miners):
+        assert x.blocks_found_mean == y.blocks_found_mean == z.blocks_found_mean
+        assert x.stale_rate_mean == y.stale_rate_mean == z.stale_rate_mean
+        assert x.blocks_share_mean == y.blocks_share_mean == z.blocks_share_mean
+
+
+def test_cpp_matches_jax_engine_honest(cpp_run):
+    """Same honest config on both backends: per-miner stale rates and shares
+    agree within a combined 5-sigma Monte-Carlo envelope."""
+    res_cpp = cpp_run(HONEST_10S, threads=4)
+
+    jax_runs = 128
+    config = SimConfig(
+        network=HONEST_10S.network,
+        duration_ms=HONEST_10S.duration_ms,
+        runs=jax_runs,
+        batch_size=jax_runs,
+        seed=19,
+    )
+    sums = Engine(config).run_batch(make_run_keys(config.seed, 0, jax_runs))
+    stale_jax = np.asarray(sums["stale_rate_sum"]) / jax_runs
+    share_jax = np.asarray(sums["blocks_share_sum"]) / jax_runs
+
+    blocks_per_run = HONEST_10S.duration_ms / 600_000.0
+    for i, mc in enumerate(HONEST_10S.network.miners):
+        h = mc.hashrate_pct / 100.0
+        own = blocks_per_run * h
+        p = res_cpp.miners[i].stale_rate_mean
+        sigma = math.sqrt(max(p, 1e-5) / own) * math.sqrt(1 / HONEST_10S.runs + 1 / jax_runs)
+        assert abs(p - stale_jax[i]) < 5 * sigma + 0.1 * p, (i, p, stale_jax[i])
+        se_share = math.sqrt(h * (1 - h) / blocks_per_run) * math.sqrt(
+            1 / HONEST_10S.runs + 1 / jax_runs
+        )
+        assert abs(res_cpp.miners[i].blocks_share_mean - share_jax[i]) < 5 * se_share
+
+
+def test_cpp_selfish_matches_golden(cpp_run):
+    """40% gamma=0 selfish miner on the native backend reproduces the
+    reference README table (README.md:89-107): share ~46.7%, selfish stale
+    ~27.5%, honest stale ~67.5%."""
+    config = SimConfig(
+        network=default_network(
+            propagation_ms=1000, selfish_ids=(0,), hashrates=(40, 19, 12, 11, 8, 5, 3, 1, 1)
+        ),
+        duration_ms=90 * 86_400_000,
+        runs=128,
+        seed=13,
+    )
+    res = cpp_run(config, threads=4)
+    assert abs(res.miners[0].blocks_share_mean - 0.467) < 0.015
+    assert abs(res.miners[0].stale_rate_mean - 0.275) < 0.02
+    honest = [m.stale_rate_mean for m in res.miners[1:]]
+    assert abs(float(np.mean(honest)) - 0.675) < 0.02
+
+
+def test_backend_registry_roundtrip(cpp_run):
+    from tpusim.backend import get_backend
+
+    assert get_backend("cpp") is not None
+    assert get_backend("pychain") is not None
+    assert get_backend("tpu") is not None
+    with pytest.raises(KeyError):
+        get_backend("cuda")

@@ -114,13 +114,14 @@ def test_event_stream_equivalence(network, mode, zero_frac, seed):
         assert_state_matches_chains(state, oracle["chains"], config.duration_ms, config)
 
 
-def _replay_pychain_with_engine_draws(config: SimConfig, run_idx: int) -> dict:
+def _replay_pychain_with_engine_draws(config: SimConfig, run_idx: int, steps: int) -> dict:
     """Host-side replica of Engine.run_batch for ONE run, driving the literal
     chain model with the exact same threefry draws and step structure
-    (tpusim.engine._step + chunking/re-basing expressed in absolute time)."""
+    (tpusim.engine._step + chunking/re-basing expressed in absolute time).
+    ``steps`` must be the engine's *resolved* chunk_steps — the engine clamps
+    the configured value to the Poisson bound, and a mismatched step count
+    silently shifts the chunk->key mapping."""
     params = make_params(config)
-    steps = config.chunk_steps
-    assert steps is not None, "replay tests must pin chunk_steps in the config"
     run_key = make_run_keys(config.seed, run_idx, 1)[0]
 
     bits0 = jax.random.bits(jax.random.fold_in(run_key, 0), (2,), jnp.uint32)
@@ -191,7 +192,9 @@ def test_engine_matches_pychain_replay(network, mode):
     engine = Engine(config)
     sums = engine.run_batch(make_run_keys(config.seed, 0, runs))
 
-    expect = [_replay_pychain_with_engine_draws(config, i) for i in range(runs)]
+    expect = [
+        _replay_pychain_with_engine_draws(config, i, engine.chunk_steps) for i in range(runs)
+    ]
     n_m = config.network.n_miners
     for name, key in [
         ("blocks_found_sum", "blocks_found"),

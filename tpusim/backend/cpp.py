@@ -1,0 +1,117 @@
+"""ctypes bindings for the native C++ backend (native/simcore.cpp).
+
+The native library is the framework's performance-credible cross-validation
+oracle: an independent materialized-chain implementation of the simulation
+semantics with the reference's std::async-style run-level threading
+(reference main.cpp:195-220) re-done as deterministic static partitioning.
+It is compiled on demand with the in-tree Makefile (g++ only; no pybind11 —
+the ABI is 5 flat arrays, ctypes is the right amount of machinery).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+from ..config import SimConfig
+from ..stats import SimResults
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+_LIB_PATH = _NATIVE_DIR / "libsimcore.so"
+_SRC_PATH = _NATIVE_DIR / "simcore.cpp"
+
+_lib: ctypes.CDLL | None = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _ensure_built() -> Path:
+    if not _SRC_PATH.exists():
+        raise NativeBuildError(f"native source missing at {_SRC_PATH}")
+    if not _LIB_PATH.exists() or _LIB_PATH.stat().st_mtime < _SRC_PATH.stat().st_mtime:
+        proc = subprocess.run(
+            ["make", "-C", str(_NATIVE_DIR), "libsimcore.so"],
+            capture_output=True,
+            text=True,
+        )
+        if proc.returncode != 0:
+            raise NativeBuildError(
+                f"building libsimcore.so failed:\n{proc.stdout}\n{proc.stderr}"
+            )
+    return _LIB_PATH
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(str(_ensure_built()))
+        dp = ctypes.POINTER(ctypes.c_double)
+        lib.simcore_run.restype = ctypes.c_int
+        lib.simcore_run.argtypes = [
+            ctypes.c_int32,  # n_miners
+            ctypes.POINTER(ctypes.c_int32),  # hashrate_pct
+            ctypes.POINTER(ctypes.c_int64),  # prop_ms
+            ctypes.POINTER(ctypes.c_uint8),  # selfish
+            ctypes.c_int64,  # duration_ms
+            ctypes.c_double,  # block_interval_s
+            ctypes.c_int64,  # runs
+            ctypes.c_uint64,  # seed
+            ctypes.c_int32,  # threads
+            dp, dp, dp, dp, dp,  # found, share, stale_rate, stale_blocks, best_height
+        ]
+        _lib = lib
+    return _lib
+
+
+def run_simulation_cpp(config: SimConfig, threads: int | None = None) -> SimResults:
+    """Run ``config`` on the native backend; returns the same SimResults shape
+    as the JAX engine path, so results are directly comparable."""
+    lib = _load()
+    n = config.network.n_miners
+    pct = np.array([m.hashrate_pct for m in config.network.miners], dtype=np.int32)
+    prop = np.array([m.propagation_ms for m in config.network.miners], dtype=np.int64)
+    selfish = np.array([m.selfish for m in config.network.miners], dtype=np.uint8)
+    found = np.zeros(n, np.float64)
+    share = np.zeros(n, np.float64)
+    stale_rate = np.zeros(n, np.float64)
+    stale_blocks = np.zeros(n, np.float64)
+    best = np.zeros(1, np.float64)
+
+    import time
+
+    t0 = time.monotonic()
+    rc = lib.simcore_run(
+        n,
+        pct.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        prop.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        selfish.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        config.duration_ms,
+        config.network.block_interval_s,
+        config.runs,
+        config.seed,
+        0 if threads is None else threads,
+        found.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        share.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        stale_rate.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        stale_blocks.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        best.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+    )
+    if rc != 0:
+        raise ValueError(f"simcore_run rejected the configuration (code {rc})")
+    elapsed = time.monotonic() - t0
+
+    sums = {
+        "runs": np.int64(config.runs),
+        "blocks_found_sum": found,
+        "blocks_share_sum": share,
+        "stale_rate_sum": stale_rate,
+        "stale_blocks_sum": stale_blocks,
+        "best_height_sum": best[0],
+        "overflow_sum": np.int64(0),
+    }
+    return SimResults.from_sums(sums, config, mode="cpp", elapsed_s=elapsed, compile_s=0.0)

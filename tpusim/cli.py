@@ -44,6 +44,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--batch-size", type=int, default=4096)
     p.add_argument("--mode", choices=("auto", "exact", "fast"), default="auto")
+    p.add_argument(
+        "--backend",
+        choices=("tpu", "cpp"),
+        default="tpu",
+        help="execution backend: the JAX engine (default) or the native C++ oracle",
+    )
+    p.add_argument("--threads", type=int, default=0, help="cpp backend: OS threads (0 = all cores)")
     p.add_argument("--checkpoint", type=Path, help="npz path for batch-level checkpoint/resume")
     p.add_argument("--json", type=Path, help="also write structured results to this path")
     p.add_argument("--single-device", action="store_true", help="disable multi-device sharding")
@@ -83,27 +90,38 @@ def main(argv: list[str] | None = None) -> int:
     except ValueError as e:
         raise SystemExit(f"error: {e}") from None
 
-    import jax
+    if args.backend == "cpp":
+        if args.checkpoint:
+            raise SystemExit(
+                "error: --checkpoint is only supported on the tpu backend; "
+                "the cpp oracle runs to completion in one call"
+            )
+        from .backend.cpp import run_simulation_cpp
 
-    from .runner import run_simulation_config
+        print(f"Running {config.runs} simulations on the native C++ backend.")
+        results = run_simulation_cpp(config, threads=args.threads or None)
+    else:
+        import jax
 
-    n_dev = len(jax.devices())
-    print(
-        f"Running {config.runs} simulations in parallel using {n_dev} "
-        f"{jax.devices()[0].platform} device(s)."
-    )
+        from .runner import run_simulation_config
 
-    def progress(done: int, total: int) -> None:
-        print(f"\r{done * 100 // total}% progress..", end="", flush=True)
+        n_dev = len(jax.devices())
+        print(
+            f"Running {config.runs} simulations in parallel using {n_dev} "
+            f"{jax.devices()[0].platform} device(s)."
+        )
 
-    results = run_simulation_config(
-        config,
-        use_all_devices=not args.single_device,
-        progress=None if args.quiet else progress,
-        checkpoint_path=args.checkpoint,
-    )
-    if not args.quiet:
-        print()
+        def progress(done: int, total: int) -> None:
+            print(f"\r{done * 100 // total}% progress..", end="", flush=True)
+
+        results = run_simulation_config(
+            config,
+            use_all_devices=not args.single_device,
+            progress=None if args.quiet else progress,
+            checkpoint_path=args.checkpoint,
+        )
+        if not args.quiet:
+            print()
     print(results.table())
     if results.overflow_total:
         print(f"  [diagnostics: {results.overflow_total} group-slot overflows]")

@@ -1,0 +1,196 @@
+"""Declarative sweep driver for the baseline configuration grids.
+
+The reference's workflow for exploring a parameter space is edit-and-recompile
+(reference README.md:21-27, main.cpp:7-10,44-65). Here every BASELINE.json
+sweep is a generated list of named SimConfig points that runs from the CLI
+with no code edits, emits one JSON line per point (the structured counterpart
+of the reference's stdout table), and checkpoints per point so a preempted
+TPU job resumes at point granularity.
+
+    python -m tpusim.sweep --list
+    python -m tpusim.sweep propagation --runs-scale 0.001 --out prop.jsonl
+    python -m tpusim.sweep selfish-threshold --backend cpp --runs-scale 1e-4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+from typing import Callable, Iterable
+
+from .config import MinerConfig, NetworkConfig, SimConfig, default_network
+
+#: 2025 pool hashrate distribution used across the baseline sweeps.
+_DIST_2025 = (30, 29, 12, 11, 8, 5, 3, 1, 1)
+
+
+def _split_pct(total: int, parts: int) -> tuple[int, ...]:
+    """Split an integer percentage into ``parts`` integers summing to total."""
+    base, rem = divmod(total, parts)
+    return tuple(base + (1 if i < rem else 0) for i in range(parts))
+
+
+def _selfish_network(selfish_pct: int, propagation_ms: int = 1000) -> NetworkConfig:
+    peers = _split_pct(100 - selfish_pct, 8)
+    miners = (MinerConfig(hashrate_pct=selfish_pct, propagation_ms=propagation_ms, selfish=True),) + tuple(
+        MinerConfig(hashrate_pct=p, propagation_ms=propagation_ms) for p in peers
+    )
+    return NetworkConfig(miners=miners)
+
+
+def _hetero32_network() -> NetworkConfig:
+    """32 miners, heterogeneous propagation: hashrates follow a truncated
+    power-law-ish integer split of 100%; propagation spans 100 ms - 60 s."""
+    hashrates = [14, 11, 9, 8, 6, 5, 4, 3] + [2] * 16 + [1] * 8
+    assert len(hashrates) == 32 and sum(hashrates) == 100
+    props = [100 * (600 ** (i / 31)) for i in range(32)]  # 100 ms .. 60 s, log-spaced
+    miners = tuple(
+        MinerConfig(hashrate_pct=h, propagation_ms=int(p))
+        for h, p in zip(hashrates, props)
+    )
+    return NetworkConfig(miners=miners)
+
+
+def baseline_sweeps() -> dict[str, Callable[[], list[tuple[str, SimConfig]]]]:
+    """The five BASELINE.json sweep grids, as named lazy generators."""
+
+    def reference_default() -> list[tuple[str, SimConfig]]:
+        # BASELINE.json configs[0]: 10 s propagation, honest, 365 d, 1024 runs.
+        return [
+            (
+                "ref-10s",
+                SimConfig(
+                    network=default_network(propagation_ms=10_000),
+                    runs=1024,
+                ),
+            )
+        ]
+
+    def propagation() -> list[tuple[str, SimConfig]]:
+        # configs[1]: propagation sweep {100ms, 1s, 10s, 60s}, 2^20 runs.
+        return [
+            (
+                f"prop-{ms}ms",
+                SimConfig(network=default_network(propagation_ms=ms), runs=2**20),
+            )
+            for ms in (100, 1000, 10_000, 60_000)
+        ]
+
+    def selfish_hashrate() -> list[tuple[str, SimConfig]]:
+        # configs[2]: miner-0 selfish, hashrate sweep 25-49%, 8 honest peers.
+        return [
+            (f"selfish-{pct}pct", SimConfig(network=_selfish_network(pct), runs=2**20))
+            for pct in range(25, 50, 3)
+        ]
+
+    def hetero32() -> list[tuple[str, SimConfig]]:
+        # configs[3]: heterogeneous propagation, 32 miners, 2^22 runs.
+        return [("hetero32", SimConfig(network=_hetero32_network(), runs=2**22))]
+
+    def selfish_threshold() -> list[tuple[str, SimConfig]]:
+        # configs[4]: block-interval sweep x selfish-threshold grid, 2^24 runs.
+        points = []
+        for interval_s in (150.0, 300.0, 600.0):
+            for pct in (25, 30, 35, 40, 45):
+                net = _selfish_network(pct)
+                net = NetworkConfig(miners=net.miners, block_interval_s=interval_s)
+                points.append(
+                    (
+                        f"interval-{int(interval_s)}s-selfish-{pct}pct",
+                        SimConfig(network=net, runs=2**24),
+                    )
+                )
+        return points
+
+    return {
+        "reference-default": reference_default,
+        "propagation": propagation,
+        "selfish-hashrate": selfish_hashrate,
+        "hetero32": hetero32,
+        "selfish-threshold": selfish_threshold,
+    }
+
+
+def run_sweep(
+    points: Iterable[tuple[str, SimConfig]],
+    *,
+    backend: str = "tpu",
+    runs_scale: float = 1.0,
+    out_path: Path | None = None,
+    checkpoint_dir: Path | None = None,
+    quiet: bool = False,
+) -> list[dict]:
+    """Run every point; returns (and optionally appends as JSONL) result dicts.
+
+    ``runs_scale`` scales each point's run count (floor, min 1) so the full
+    2^20-2^24 production grids can be smoke-run at any budget.
+    """
+    import dataclasses
+
+    from .backend import get_backend
+
+    results = []
+    for name, config in points:
+        runs = max(1, int(config.runs * runs_scale))
+        config = dataclasses.replace(config, runs=runs)
+        t0 = time.monotonic()
+        if backend == "tpu":
+            kwargs = {}
+            if checkpoint_dir is not None:
+                checkpoint_dir.mkdir(parents=True, exist_ok=True)
+                kwargs["checkpoint_path"] = checkpoint_dir / f"{name}.npz"
+            res = get_backend("tpu")(config, **kwargs)
+        else:
+            res = get_backend(backend)(config)
+        row = {
+            "point": name,
+            "backend": backend,
+            "elapsed_s": round(time.monotonic() - t0, 3),
+            **res.to_dict(),
+        }
+        results.append(row)
+        if out_path is not None:
+            with out_path.open("a") as fh:
+                fh.write(json.dumps(row) + "\n")
+        if not quiet:
+            print(f"[{name}] done in {row['elapsed_s']}s ({runs} runs)")
+    return results
+
+
+def main(argv: list[str] | None = None) -> int:
+    sweeps = baseline_sweeps()
+    p = argparse.ArgumentParser(prog="tpusim.sweep", description=__doc__)
+    p.add_argument("sweep", nargs="?", choices=sorted(sweeps), help="which baseline grid")
+    p.add_argument("--list", action="store_true", help="list sweeps and their points")
+    p.add_argument("--backend", default="tpu", choices=("tpu", "cpp"))
+    p.add_argument("--runs-scale", type=float, default=1.0)
+    p.add_argument("--out", type=Path, help="append one JSON line per point here")
+    p.add_argument("--checkpoint-dir", type=Path, help="per-point npz checkpoints (tpu backend)")
+    p.add_argument("--quiet", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.list or not args.sweep:
+        for name, gen in sorted(sweeps.items()):
+            points = gen()
+            total = sum(c.runs for _, c in points)
+            print(f"{name}: {len(points)} points, {total} total runs")
+            for pname, c in points:
+                print(f"  - {pname}: {c.network.n_miners} miners, {c.runs} runs")
+        return 0
+
+    run_sweep(
+        sweeps[args.sweep](),
+        backend=args.backend,
+        runs_scale=args.runs_scale,
+        out_path=args.out,
+        checkpoint_dir=args.checkpoint_dir,
+        quiet=args.quiet,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
