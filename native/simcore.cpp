@@ -143,6 +143,14 @@ struct MinerRun {
     if (selfish) {
       // Winning a 1-block race: exactly one secret block and the public best
       // matched our length — publish the secret block and the new one.
+      // Reachability: after any notify sweep, maybe_reveal guarantees
+      // private_len() <= lead (it reveals whenever secret > lead), so
+      // secret == 1 together with best_len == chain.size() (lead 0) cannot
+      // survive a sweep and this branch never fires dynamically. It is part
+      // of the behavioral contract nonetheless (reference simulation.h:62-76
+      // has the identical branch with the identical invariant, unit-tested
+      // as case b of the 2013 paper) and is covered the same way by
+      // tests/test_selfish_automaton.py, so it is kept for exact parity.
       if (private_len() == 1 && best_len == chain.size()) {
         chain.back().arrival = t + prop_ms;
         chain.push_back({idx, t + prop_ms});
@@ -281,6 +289,20 @@ RunOut simulate_run(const std::vector<MinerCfg>& cfg, int64_t duration_ms,
 // ---------------------------------------------------------------------------
 
 extern "C" {
+
+// First `n` raw xoroshiro128++ outputs for the given seed, split into uint32
+// (hi, lo) limb pairs. Exists so the Python/JAX articulation of the generator
+// (tpusim/xoroshiro.py) can be contract-tested bit-for-bit against this one.
+int simcore_rng_words(uint64_t seed, int64_t n, uint32_t* hi, uint32_t* lo) {
+  if (n < 0) return 1;
+  Xoro rng(seed);
+  for (int64_t i = 0; i < n; ++i) {
+    const uint64_t w = rng.next();
+    hi[i] = static_cast<uint32_t>(w >> 32);
+    lo[i] = static_cast<uint32_t>(w & 0xFFFFFFFFu);
+  }
+  return 0;
+}
 
 // Runs `runs` independent simulations over `threads` OS threads and writes
 // per-miner sums of (found, share, stale_rate, stale_blocks) plus the summed

@@ -33,16 +33,17 @@ class NativeBuildError(RuntimeError):
 def _ensure_built() -> Path:
     if not _SRC_PATH.exists():
         raise NativeBuildError(f"native source missing at {_SRC_PATH}")
-    if not _LIB_PATH.exists() or _LIB_PATH.stat().st_mtime < _SRC_PATH.stat().st_mtime:
-        proc = subprocess.run(
-            ["make", "-C", str(_NATIVE_DIR), "libsimcore.so"],
-            capture_output=True,
-            text=True,
+    # Always invoke make: it is a no-op when up to date and, unlike a
+    # hand-rolled mtime check, also rebuilds on Makefile/flag changes.
+    proc = subprocess.run(
+        ["make", "-C", str(_NATIVE_DIR), "libsimcore.so"],
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode != 0:
+        raise NativeBuildError(
+            f"building libsimcore.so failed:\n{proc.stdout}\n{proc.stderr}"
         )
-        if proc.returncode != 0:
-            raise NativeBuildError(
-                f"building libsimcore.so failed:\n{proc.stdout}\n{proc.stderr}"
-            )
     return _LIB_PATH
 
 

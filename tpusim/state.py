@@ -241,6 +241,13 @@ def found_block(state: SimState, params: SimParams, w: jax.Array) -> SimState:
       * selfish winning a 1-block race (exactly one private block and the best
         published chain matched our length at the last notify): publish the
         private block *and* the new one, both arriving at ``t + propagation``.
+
+    Reachability note: after any notify, the reveal rule guarantees
+    ``n_private <= lead``, so ``n_private == 1`` together with
+    ``best_height_prev == height`` (lead 0) cannot survive a sweep — the race
+    branch never fires dynamically. The reference carries the identical branch
+    with the identical invariant (simulation.h:62-76, unit-tested as the 2013
+    paper's case b); it is kept and unit-tested here the same way for parity.
     """
     m = state.height.shape[0]
     onehot_w = jnp.arange(m) == w

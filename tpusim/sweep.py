@@ -34,11 +34,11 @@ def _split_pct(total: int, parts: int) -> tuple[int, ...]:
 
 
 def _selfish_network(selfish_pct: int, propagation_ms: int = 1000) -> NetworkConfig:
-    peers = _split_pct(100 - selfish_pct, 8)
-    miners = (MinerConfig(hashrate_pct=selfish_pct, propagation_ms=propagation_ms, selfish=True),) + tuple(
-        MinerConfig(hashrate_pct=p, propagation_ms=propagation_ms) for p in peers
+    return default_network(
+        propagation_ms=propagation_ms,
+        selfish_ids=(0,),
+        hashrates=(selfish_pct, *_split_pct(100 - selfish_pct, 8)),
     )
-    return NetworkConfig(miners=miners)
 
 
 def _hetero32_network() -> NetworkConfig:
@@ -132,6 +132,12 @@ def run_sweep(
 
     from .backend import get_backend
 
+    if backend not in ("tpu", "cpp"):
+        raise ValueError(
+            f"run_sweep supports the 'tpu' and 'cpp' backends, got {backend!r} "
+            f"(the pychain oracle returns raw chains, not SimResults)"
+        )
+
     results = []
     for name, config in points:
         runs = max(1, int(config.runs * runs_scale))
@@ -145,11 +151,14 @@ def run_sweep(
             res = get_backend("tpu")(config, **kwargs)
         else:
             res = get_backend(backend)(config)
+        # Spread first: the sweep's own wall-clock (which includes checkpoint
+        # setup and native build overhead) must win over the backend-internal
+        # elapsed_s inside to_dict().
         row = {
+            **res.to_dict(),
             "point": name,
             "backend": backend,
             "elapsed_s": round(time.monotonic() - t0, 3),
-            **res.to_dict(),
         }
         results.append(row)
         if out_path is not None:

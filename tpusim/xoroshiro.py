@@ -1,0 +1,170 @@
+"""Vectorized xoroshiro128++ — bit-compatible with the reference generator.
+
+The reference simulator draws everything from xoroshiro128++ seeded by two
+successive splitmix64 outputs (reference xoroshiro128++.h:1-40; algorithm by
+Blackman & Vigna, public domain). The TPU engine's default sampling uses JAX's
+counter-based threefry instead (tpusim.sampling — statistically equivalent and
+order-independent, which is what the vectorized engine needs), but a
+bit-compatible generator is kept here for parity and for contract-testing the
+native backend's generator from Python:
+
+  * TPUs have no 64-bit integer ALU, so a 64-bit word lives as a uint32
+    (hi, lo) pair. The xoroshiro128++ update needs only XOR, shifts and
+    adds across the pair — no multiplies — so every step is a handful of
+    32-bit vector ops, vectorizable over any number of independent streams.
+  * Seeding (splitmix64) multiplies 64-bit constants, so it runs host-side in
+    numpy uint64 (`seed_streams`), exactly as cheap and exactly once per
+    stream.
+
+``tests/test_xoroshiro.py`` pins this implementation against an independent
+pure-Python big-int model and against the native backend's C++ generator
+(``simcore_rng_words``), so the Python, JAX and C++ articulations of the
+generator are mutually bit-exact.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["XoroStreams", "seed_streams", "next_words", "next_uniform", "exporand"]
+
+U32 = jnp.uint32
+_MASK32 = np.uint64(0xFFFFFFFF)
+
+
+class XoroStreams(NamedTuple):
+    """N independent xoroshiro128++ streams as uint32 limb arrays."""
+
+    s0_hi: jax.Array
+    s0_lo: jax.Array
+    s1_hi: jax.Array
+    s1_lo: jax.Array
+
+
+def _splitmix64(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """One splitmix64 step: returns (advanced state, output). numpy uint64."""
+    with np.errstate(over="ignore"):
+        x = x + np.uint64(0x9E3779B97F4A7C15)
+        z = x.copy()
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        z = z ^ (z >> np.uint64(31))
+    return x, z
+
+
+def seed_streams(seeds) -> XoroStreams:
+    """Seed one stream per element of ``seeds`` (uint64), reference-style:
+    both state words come from successive splitmix64 outputs of the same
+    advancing seed state (reference xoroshiro128++.h:9-15,23-24)."""
+    s = np.atleast_1d(np.asarray(seeds, dtype=np.uint64)).copy()
+    s, w0 = _splitmix64(s)
+    _, w1 = _splitmix64(s)
+    return XoroStreams(
+        s0_hi=jnp.asarray((w0 >> np.uint64(32)).astype(np.uint32)),
+        s0_lo=jnp.asarray((w0 & _MASK32).astype(np.uint32)),
+        s1_hi=jnp.asarray((w1 >> np.uint64(32)).astype(np.uint32)),
+        s1_lo=jnp.asarray((w1 & _MASK32).astype(np.uint32)),
+    )
+
+
+def _add64(ah, al, bh, bl):
+    lo = al + bl
+    carry = (lo < al).astype(U32)
+    return ah + bh + carry, lo
+
+
+def _rotl64(h, l, k: int):
+    k %= 64
+    if k == 0:
+        return h, l
+    if k == 32:
+        return l, h
+    if k < 32:
+        kk = U32(k)
+        ik = U32(32 - k)
+        return (h << kk) | (l >> ik), (l << kk) | (h >> ik)
+    kk = U32(k - 32)
+    ik = U32(64 - k)
+    return (l << kk) | (h >> ik), (h << kk) | (l >> ik)
+
+
+def _shl64(h, l, k: int):
+    if k == 0:
+        return h, l
+    if k >= 32:
+        return l << U32(k - 32), jnp.zeros_like(l)
+    return (h << U32(k)) | (l >> U32(32 - k)), l << U32(k)
+
+
+def next_words(state: XoroStreams) -> tuple[XoroStreams, jax.Array, jax.Array]:
+    """Advance every stream one step; returns (state, out_hi, out_lo).
+
+    out = rotl(s0 + s1, 17) + s0; s1 ^= s0;
+    s0' = rotl(s0, 49) ^ s1 ^ (s1 << 21); s1' = rotl(s1, 28).
+    """
+    s0h, s0l, s1h, s1l = state
+    th, tl = _add64(s0h, s0l, s1h, s1l)
+    th, tl = _rotl64(th, tl, 17)
+    oh, ol = _add64(th, tl, s0h, s0l)
+
+    x1h, x1l = s1h ^ s0h, s1l ^ s0l
+    r49h, r49l = _rotl64(s0h, s0l, 49)
+    sh21h, sh21l = _shl64(x1h, x1l, 21)
+    n0h = r49h ^ x1h ^ sh21h
+    n0l = r49l ^ x1l ^ sh21l
+    n1h, n1l = _rotl64(x1h, x1l, 28)
+    return XoroStreams(n0h, n0l, n1h, n1l), oh, ol
+
+
+def next_uniform(state: XoroStreams) -> tuple[XoroStreams, jax.Array]:
+    """Uniform in [0, 1) from the top bits of the next word.
+
+    The reference maps the top 53 bits onto a double (xoroshiro128++.h:17-20).
+    On CPU (float64 enabled) this reproduces that exactly; on TPU, where only
+    float32 exists, the top 24 bits are used — the generator stays bit-exact,
+    only the final float mapping is quantized.
+    """
+    state, hi, lo = next_words(state)
+    if jax.dtypes.canonicalize_dtype(jnp.float64) == jnp.float64:
+        u = (hi.astype(jnp.uint64) << jnp.uint64(32) | lo.astype(jnp.uint64)) >> jnp.uint64(11)
+        return state, u.astype(jnp.float64) * jnp.float64(2.0**-53)
+    return state, (hi >> U32(8)).astype(jnp.float32) * jnp.float32(2.0**-24)
+
+
+def exporand(state: XoroStreams, mean) -> tuple[XoroStreams, jax.Array]:
+    """Exponential draw with the given mean via the reference's inverse-CDF
+    construction ``-log1p(-u) * mean`` (xoroshiro128++.h:36-39)."""
+    state, u = next_uniform(state)
+    return state, -jnp.log1p(-u) * mean
+
+
+def reference_words(seed: int, n: int) -> np.ndarray:
+    """First ``n`` outputs of one stream, computed host-side in pure-Python
+    big-int arithmetic — deliberately sharing no code with ``seed_streams``
+    (including splitmix64), so it is a fully independent golden-value model
+    for the cross-language contract tests."""
+    mask = 0xFFFFFFFFFFFFFFFF
+
+    def smix(x: int) -> tuple[int, int]:
+        x = (x + 0x9E3779B97F4A7C15) & mask
+        z = x
+        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & mask
+        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & mask
+        return x, z ^ (z >> 31)
+
+    def rotl(v: int, k: int) -> int:
+        return ((v << k) | (v >> (64 - k))) & mask
+
+    s, s0 = smix(int(seed) & mask)
+    _, s1 = smix(s)
+    out = np.empty(n, dtype=np.uint64)
+    for i in range(n):
+        out[i] = np.uint64((rotl((s0 + s1) & mask, 17) + s0) & mask)
+        x1 = s1 ^ s0
+        s0 = rotl(s0, 49) ^ x1 ^ ((x1 << 21) & mask)
+        s1 = rotl(x1, 28)
+    return out
