@@ -35,11 +35,17 @@ def _ensure_built() -> Path:
         raise NativeBuildError(f"native source missing at {_SRC_PATH}")
     # Always invoke make: it is a no-op when up to date and, unlike a
     # hand-rolled mtime check, also rebuilds on Makefile/flag changes.
-    proc = subprocess.run(
-        ["make", "-C", str(_NATIVE_DIR), "libsimcore.so"],
-        capture_output=True,
-        text=True,
-    )
+    try:
+        proc = subprocess.run(
+            ["make", "-C", str(_NATIVE_DIR), "libsimcore.so"],
+            capture_output=True,
+            text=True,
+        )
+    except FileNotFoundError:
+        # No build toolchain on PATH: a prebuilt library is still usable.
+        if _LIB_PATH.exists():
+            return _LIB_PATH
+        raise NativeBuildError("make not found and no prebuilt libsimcore.so") from None
     if proc.returncode != 0:
         raise NativeBuildError(
             f"building libsimcore.so failed:\n{proc.stdout}\n{proc.stderr}"

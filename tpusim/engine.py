@@ -72,37 +72,53 @@ def default_n_steps(duration_ms: int, block_interval_s: float) -> int:
     return int(2.0 * (mu + 8.0 * math.sqrt(mu + 1.0))) + 16
 
 
-def _tree_select(pred: jax.Array, new, old):
-    return jax.tree_util.tree_map(lambda a, b: jnp.where(pred, a, b), new, old)
-
-
-def _step(state: SimState, bits2: jax.Array, params: SimParams, cap: jax.Array) -> SimState:
+def _step(
+    state: SimState, bits2: jax.Array, params: SimParams, cap: jax.Array, any_selfish: bool
+) -> SimState:
     """One event: a block find if one is due at ``t``, then the notify sweep,
     then cut-through time advance. ``cap`` freezes the run when it passes its
-    chunk-relative end (duration reached, or TIME_CAP pending a re-base)."""
+    chunk-relative end (duration reached, or TIME_CAP pending a re-base).
+
+    Event gating is pushed *into* the updates instead of post-hoc tree
+    selects: a winner index of -1 makes ``found_block`` an exact identity, and
+    ``notify(do=...)`` gates its flush/reveal/adopt masks — so every state
+    leaf is computed and written once per step.
+    """
     active = state.t < cap
     w = winner_from_bits(bits2[0], params.thresholds)
     dt = interval_from_bits(bits2[1], params.mean_interval_ms)
 
     found_due = active & (state.t == state.next_block_time)
-    after_found = found_block(state, params, w)
-    after_found = after_found._replace(next_block_time=state.t + dt)
-    state1 = _tree_select(found_due, after_found, state)
+    state1 = found_block(state, params, jnp.where(found_due, w, jnp.int32(-1)), any_selfish)
+    nbt = jnp.where(found_due, state.t + dt, state.next_block_time)
+    state1 = state1._replace(next_block_time=nbt)
 
     # Another find due at the same millisecond: defer the notify, matching the
     # reference's while-drain (main.cpp:151-157). Between two same-ms finds no
     # published state changes (all stamps are in the future), so deferral is
     # only load-bearing for 0ms-propagation configs.
-    skip_notify = found_due & (state1.next_block_time == state.t)
-    notified = notify(state1, params)
-    state2 = _tree_select(active & ~skip_notify, notified, state1)
+    do_notify = active & ~(found_due & (nbt == state.t))
+    state2 = notify(state1, params, do=do_notify, any_selfish=any_selfish)
 
     # Cut-through to the next event (main.cpp:173-182). The max() guard keeps
     # time in place when a same-ms find is still pending (unflushed arrivals
     # could otherwise pull the min below cur_time).
     new_t = jnp.maximum(jnp.minimum(state2.next_block_time, earliest_arrival(state2)), state2.t)
-    state3 = state2._replace(t=new_t)
-    return _tree_select(active, state3, state)
+    return state2._replace(t=jnp.where(active, new_t, state.t))
+
+
+# Design note (negative result, kept so it is not re-attempted): stepping one
+# *block* per scan step with all arrival-time notifies batched into the next
+# find's pre-find flush is observationally exact for chains, shares and
+# found counts (adoption is path-independent between finds), but NOT for the
+# reference's stale accounting: an own block popped by an intermediate
+# adoption and later re-included via a third branch (a >=triple-race
+# geometry) is counted stale by the reference's per-arrival reorgs
+# (simulation.h:129-135) yet invisible to a single batched reorg. Restoring
+# exactness needs one notify round per distinct pending-arrival time, whose
+# SIMD batch-max cost erases the halved step count. Verified empirically by
+# tests/test_state_equivalence.py on the heterogeneous-propagation stream
+# (seed 13, run 2: stale 3 vs 2). Event stepping stays.
 
 
 class Engine:
@@ -122,6 +138,7 @@ class Engine:
         self.params = make_params(config)
         self.n_miners = config.network.n_miners
         self.exact = config.resolved_mode == "exact"
+        self.any_selfish = config.network.any_selfish
         bound = default_n_steps(config.duration_ms, config.network.block_interval_s)
         # A run freezes at TIME_CAP within a chunk regardless of steps left, so
         # a chunk larger than one TIME_CAP span's event bound only burns scan
@@ -141,6 +158,7 @@ class Engine:
         )
 
         m, k, exact, steps = self.n_miners, config.group_slots, self.exact, self.chunk_steps
+        any_selfish = self.any_selfish
 
         def init_fn(run_key: jax.Array, params: SimParams) -> SimState:
             state = init_state(m, k, exact)
@@ -157,7 +175,7 @@ class Engine:
             bits = jax.random.bits(key, (steps, 2), jnp.uint32)
 
             def body(carry: SimState, xs: jax.Array):
-                return _step(carry, xs, params, cap), None
+                return _step(carry, xs, params, cap, any_selfish), None
 
             state, _ = jax.lax.scan(body, state, bits)
             return rebase(state)

@@ -165,8 +165,15 @@ def rebase(state: SimState) -> tuple[SimState, jax.Array]:
         t=jnp.zeros((), TIME),
         next_block_time=state.next_block_time - t,
         base_tip_arrival=jnp.maximum(state.base_tip_arrival - t, NEG_TIME_CAP),
+        # Pending arrivals clamp at NEG_TIME_CAP like base tips. In event
+        # stepping they are always > t at re-base (cut-through never passes a
+        # pending arrival), so the clamp is a defensive no-op — but it is what
+        # guarantees the invariant the notify() do-gate relies on: every
+        # stored arrival >= NEG_TIME_CAP.
         group_arrival=jnp.where(
-            state.group_arrival >= INF_TIME, INF_TIME, state.group_arrival - t
+            state.group_arrival >= INF_TIME,
+            INF_TIME,
+            jnp.maximum(state.group_arrival - t, NEG_TIME_CAP),
         ),
     ), t
 
@@ -232,8 +239,15 @@ def _flush_groups(
     return arr_new, cnt_new, new_base
 
 
-def found_block(state: SimState, params: SimParams, w: jax.Array) -> SimState:
-    """Miner ``w`` finds a block at ``state.t``.
+def found_block(
+    state: SimState, params: SimParams, w: jax.Array, any_selfish: bool = True
+) -> SimState:
+    """Miner ``w`` finds a block at ``state.t``; ``w == -1`` is an identity
+    (no one-hot matches), which is how the engine expresses "no find due this
+    step" without a post-hoc select over every state leaf.
+
+    ``any_selfish`` is a *static* flag: when False (honest-only roster) the
+    private/race logic is dropped at trace time, not masked at run time.
 
     Semantics of ``Miner::FoundBlock`` (reference simulation.h:62-76):
       * honest: append an own block arriving at ``t + propagation``;
@@ -251,23 +265,31 @@ def found_block(state: SimState, params: SimParams, w: jax.Array) -> SimState:
     """
     m = state.height.shape[0]
     onehot_w = jnp.arange(m) == w
-    is_selfish = jnp.any(onehot_w & params.selfish)
-    n_private_w = _at(state.n_private, onehot_w)
-    height_w = _at(state.height, onehot_w)
-    is_race = is_selfish & (n_private_w == 1) & (state.best_height_prev == height_w)
-    private_append = is_selfish & ~is_race
+    if any_selfish:
+        is_selfish = jnp.any(onehot_w & params.selfish)
+        n_private_w = _at(state.n_private, onehot_w)
+        height_w = _at(state.height, onehot_w)
+        is_race = is_selfish & (n_private_w == 1) & (state.best_height_prev == height_w)
+        private_append = is_selfish & ~is_race
+        push_count = jnp.where(is_race, I32(2), I32(1))
+        push_do = onehot_w & ~private_append
+        n_private = state.n_private + jnp.where(
+            onehot_w,
+            jnp.where(private_append, I32(1), jnp.where(is_race, I32(-1), I32(0))),
+            I32(0),
+        )
+    else:
+        push_count = I32(1)
+        push_do = onehot_w
+        n_private = state.n_private
 
     arrival = state.t + params.prop_ms  # [M]
-    push_count = jnp.where(is_race, I32(2), I32(1))
     arr, cnt, over = _push_groups(
         state.group_arrival,
         state.group_count,
         arrival,
         jnp.full((m,), push_count, I32),
-        onehot_w & ~private_append,
-    )
-    n_private = state.n_private + jnp.where(
-        onehot_w, jnp.where(private_append, I32(1), jnp.where(is_race, I32(-1), I32(0))), I32(0)
+        push_do,
     )
     height = state.height + onehot_w.astype(I32)
 
@@ -313,7 +335,12 @@ def _best_chain(
     return onehot_b, pub_height, best_h, best_tip
 
 
-def notify(state: SimState, params: SimParams) -> SimState:
+def notify(
+    state: SimState,
+    params: SimParams,
+    do: Optional[jax.Array] = None,
+    any_selfish: bool = True,
+) -> SimState:
     """One best-chain recompute + notify-all sweep at ``state.t``.
 
     Mirrors one iteration tail of the reference event loop (main.cpp:160-171):
@@ -323,10 +350,20 @@ def notify(state: SimState, params: SimParams) -> SimState:
     iterates miners sequentially against one fixed best-chain span; no miner's
     notify can affect another's within a sweep, so the vectorized simultaneous
     update is equivalent.
+
+    ``do`` (bool scalar, optional) gates the whole sweep: when False every
+    state leaf passes through unchanged. The gate is pushed into the flush /
+    reveal / adopt masks so the engine's scan step needs no post-hoc select
+    over the state tree. ``any_selfish=False`` (static) drops the reveal logic
+    at trace time for honest-only rosters.
     """
     m = state.height.shape[0]
+    # Every stored arrival is >= NEG_TIME_CAP (pushes stamp t + prop >= 0;
+    # re-basing clamps at NEG_TIME_CAP), so flushing "as of a time below
+    # NEG_TIME_CAP" is an exact no-op — the do-gate in one where().
+    t_flush = state.t if do is None else jnp.where(do, state.t, NEG_TIME_CAP - 1)
     arr, cnt, base_tip = _flush_groups(
-        state.group_arrival, state.group_count, state.base_tip_arrival, state.t
+        state.group_arrival, state.group_count, state.base_tip_arrival, t_flush
     )
     onehot_b, pub_height, best_h, best_tip = _best_chain(
         state.height, state.n_private, cnt, base_tip
@@ -335,16 +372,24 @@ def notify(state: SimState, params: SimParams) -> SimState:
 
     # --- Selfish reveal (simulation.h:149-174). Runs before reorg; only for
     # miners whose chain is at least as long as the best published one.
-    lead = state.height - best_h
-    sc = state.n_private
-    can_reveal = params.selfish & (lead >= 0) & (sc > lead)
-    reveal_n = jnp.where((sc > 1) & (lead == 1), sc, sc - lead)
-    arr, cnt, over = _push_groups(arr, cnt, state.t + params.prop_ms, reveal_n, can_reveal)
-    n_private = jnp.where(can_reveal, sc - reveal_n, sc)
+    if any_selfish:
+        lead = state.height - best_h
+        sc = state.n_private
+        can_reveal = params.selfish & (lead >= 0) & (sc > lead)
+        if do is not None:
+            can_reveal &= do
+        reveal_n = jnp.where((sc > 1) & (lead == 1), sc, sc - lead)
+        arr, cnt, over = _push_groups(arr, cnt, state.t + params.prop_ms, reveal_n, can_reveal)
+        n_private = jnp.where(can_reveal, sc - reveal_n, sc)
+    else:
+        over = I32(0)
+        n_private = state.n_private
 
     # --- Reorg (simulation.h:124-142): adopt the best chain when strictly
     # longer than the *full* local chain (private blocks included).
     adopt = best_h > state.height
+    if do is not None:
+        adopt &= do
     unpub_b = _at(state.height, onehot_b) - best_h
 
     cp = state.cp
@@ -395,9 +440,10 @@ def notify(state: SimState, params: SimParams) -> SimState:
     arr = jnp.where(adopt[:, None], INF_TIME, arr)
     cnt = jnp.where(adopt[:, None], 0, cnt)
     base_tip = jnp.where(adopt, best_tip, base_tip)
+    bhp = best_h if do is None else jnp.where(do, best_h, state.best_height_prev)
 
     return state._replace(
-        best_height_prev=best_h,
+        best_height_prev=bhp,
         height=height,
         n_private=n_private,
         stale=stale,
