@@ -147,7 +147,15 @@ class Engine:
         # configs don't materialize huge per-chunk RNG buffers.
         cap_bound = default_n_steps(min(int(TIME_CAP), config.duration_ms),
                                     config.network.block_interval_s)
-        self.chunk_steps = min(config.chunk_steps or min(cap_bound, 2048), bound)
+        if config.chunk_steps is None:
+            # Auto-sized chunks round up to a multiple of 64 so the resolved
+            # value — which is part of the sampling identity and of checkpoint
+            # fingerprints — is the same on every platform, including the
+            # Pallas engine whose step blocks must divide it.
+            align = lambda v: (v + 63) // 64 * 64
+            self.chunk_steps = min(align(min(cap_bound, 2048)), align(bound))
+        else:
+            self.chunk_steps = min(config.chunk_steps, bound)
         # Host-loop safety margin: generous vs the per-run 8-sigma bound
         # because the loop must cover the batch *max* event count; the second
         # term covers runs that freeze at TIME_CAP and re-base repeatedly.

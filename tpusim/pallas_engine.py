@@ -1,0 +1,359 @@
+"""Pallas TPU kernel engine: the event loop with run-tile state resident in
+VMEM for a whole chunk.
+
+The scan engine (tpusim.engine) pays one HBM round-trip of the entire state
+tree per event step — the lax.scan carry lives in HBM, so at ~1 KB of state
+per run each of the ~105k steps of a simulated year re-reads and re-writes
+every byte. This module re-expresses the same step as a Pallas kernel over a
+2D grid ``(run_tiles, step_blocks)``:
+
+  * state arrays are laid out **runs-last** ``(..., R)`` so independent runs
+    ride the 128-wide lane dimension of the VPU (the scan engine's runs-first
+    layout puts the tiny miner axis on lanes and wastes them);
+  * every state BlockSpec indexes by run-tile only — Pallas keeps a revisited
+    block in VMEM across the inner (step-block) grid dimension and writes it
+    back to HBM once per tile, so state traffic drops from per-step to
+    per-chunk;
+  * the threefry bits are the **same draws** as the scan engine —
+    ``random.bits(fold_in(run_key, 1+chunk), (steps, 2))`` per run, generated
+    in transposed ``(steps, 2, R)`` layout and streamed one step-block at a
+    time into VMEM — so the kernel's results are bit-identical to the scan
+    engine's and the two are cross-checked for exact equality in
+    tests/test_pallas_engine.py.
+
+The kernel implements the honest fast-mode automaton (tpusim.state with
+``any_selfish=False``: no private counters, no reveal, pairwise own_above /
+own_in consensus bookkeeping). Selfish or exact-mode configurations stay on
+the scan engine — `PallasEngine` refuses them. Semantics contract: reference
+main.cpp:128-192 event loop, simulation.h:62-142 model, via SURVEY.md §2.1.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .config import SimConfig
+from .engine import Engine
+from .sampling import winner_thresholds32
+from .state import (
+    INF_TIME,
+    INTERVAL_CAP,
+    NEG_TIME_CAP,
+    SimState,
+    rebase,
+)
+
+__all__ = ["PallasEngine"]
+
+logger = logging.getLogger("tpusim")
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+
+def _step_block_kernel(
+    # inputs streamed / revisited per grid cell
+    bits_ref,  # (SB, 2, R) uint32 — this step-block's draws
+    cap_ref,  # (1, R) int32
+    lo_ref,  # (M, 1) uint32 winner interval lower bounds
+    hi_ref,  # (M, 1) uint32 winner interval upper bounds
+    prop_ref,  # (M, 1) int32 propagation delays
+    # state input refs: copied into the output refs at the first step block
+    # of each tile (outputs are write-only until then); HBM-aliased to the
+    # outputs so the buffers are shared
+    t_in, nbt_in, height_in, stale_in, base_in,
+    garr_in, gcnt_in, oa_in, oin_in, ovf_in,
+    # state output refs (revisited: resident in VMEM across step blocks)
+    t_ref,  # (1, R) int32
+    nbt_ref,  # (1, R) int32
+    height_ref,  # (M, R) int32
+    stale_ref,  # (M, R) int32
+    base_ref,  # (M, R) int32
+    garr_ref,  # (M, K, R) int32
+    gcnt_ref,  # (M, K, R) int32
+    oa_ref,  # (M, M, R) int32 own_above
+    oin_ref,  # (M, M, R) int32 own_in
+    ovf_ref,  # (1, R) int32
+    *,
+    sb: int,
+    mean_interval_ms: float,
+):
+    m, k, r = garr_ref.shape
+
+    # First step block of this run tile: seed the VMEM-resident output blocks
+    # from the inputs. They persist across the inner grid dimension (the
+    # block index depends only on the tile) and are written back once.
+    @pl.when(pl.program_id(1) == 0)
+    def _():
+        for src, dst in [
+            (t_in, t_ref), (nbt_in, nbt_ref), (height_in, height_ref),
+            (stale_in, stale_ref), (base_in, base_ref), (garr_in, garr_ref),
+            (gcnt_in, gcnt_ref), (oa_in, oa_ref), (oin_in, oin_ref),
+            (ovf_in, ovf_ref),
+        ]:
+            dst[...] = src[...]
+
+    cap = cap_ref[...]
+    lo = lo_ref[...]  # (M, 1) broadcasts against (M, R)
+    hi = hi_ref[...]
+    prop = prop_ref[...]
+    kidx = jax.lax.broadcasted_iota(I32, (1, k, 1), 1)  # (1, K, 1)
+    midx = jax.lax.broadcasted_iota(I32, (m, 1), 0)  # (M, 1)
+    # Literals, not captured jnp constants (pallas kernels cannot close over
+    # device arrays).
+    inf = jnp.int32(int(INF_TIME))
+    neg_gate = jnp.int32(int(NEG_TIME_CAP) - 1)
+    icap = jnp.float32(int(INTERVAL_CAP))
+
+    def step(s, carry):
+        t, nbt, height, stale, base, garr, gcnt, oa, oin, ovf = carry
+        bw = bits_ref[s, 0, :][None, :]  # (1, R) uint32
+        bi = bits_ref[s, 1, :][None, :]
+
+        active = t < cap  # (1, R)
+        found_due = active & (t == nbt)
+        # Winner one-hot straight from the cumulative thresholds
+        # (simulation.h:213-221): miner m wins iff lo[m] <= u < hi[m]; the
+        # last interval is closed on the right, clamping the ~96/2^32
+        # overflow draws to the last miner exactly like winner_from_bits.
+        is_last = midx == m - 1  # (M, 1)
+        ow = (bw >= lo) & ((bw < hi) | is_last) & found_due  # (M, R)
+        # Interval draw (simulation.h:205-210 semantics, see tpusim.sampling).
+        u = (bi >> U32(8)).astype(jnp.float32) * jnp.float32(2.0**-24)
+        dt = jnp.minimum(-jnp.log1p(-u) * jnp.float32(mean_interval_ms), icap).astype(I32)
+
+        # --- FoundBlock (honest: append one block arriving at t + prop).
+        arrival = t + prop  # (M, R)
+        n = jnp.sum((gcnt > 0).astype(I32), axis=1)  # (M, R)
+        last_idx = jnp.maximum(n - 1, 0)
+        onehot_last = kidx == last_idx[:, None, :]  # (M, K, R)
+        last_arr = jnp.sum(jnp.where(onehot_last, garr, 0), axis=1)
+        merge = ow & (n > 0) & (last_arr == arrival)
+        overflowed = ow & ~merge & (n == k)
+        write_idx = jnp.where(merge | overflowed, last_idx, jnp.minimum(n, k - 1))
+        onehot_wr = (kidx == write_idx[:, None, :]) & ow[:, None, :]
+        garr = jnp.where(onehot_wr, arrival[:, None, :], garr)
+        accum = (merge | overflowed)[:, None, :]
+        gcnt = jnp.where(onehot_wr, jnp.where(accum, gcnt + 1, 1), gcnt)
+        ovf = ovf + jnp.sum(overflowed.astype(I32), axis=0, keepdims=True)
+        height = height + ow.astype(I32)
+        oa = oa + (ow[:, None, :] & ~ow[None, :, :]).astype(I32)
+        oin = oin + (ow[:, None, :] & ow[None, :, :]).astype(I32)
+        nbt = jnp.where(found_due, t + dt, nbt)
+
+        # --- Notify sweep (flush + best chain + reorg), gated like
+        # tpusim.state.notify(do=...): a sub-NEG_TIME_CAP flush time is a
+        # no-op, and adopt is masked.
+        do = active & ~(found_due & (nbt == t))
+        t_flush = jnp.where(do, t, neg_gate)  # (1, R)
+        arrived = garr <= t_flush[:, None, :]  # (M, K, R)
+        n_f = jnp.sum(arrived.astype(I32), axis=1)  # (M, R)
+        onehot_tip = kidx == (n_f - 1)[:, None, :]
+        flushed_tip = jnp.sum(jnp.where(onehot_tip, garr, 0), axis=1)
+        base = jnp.where(n_f > 0, flushed_tip, base)
+        # Compact: shifted[m, d] = garr[m, d + n_f[m]] via a K x K one-hot
+        # sel[m, d, s] = (s == d + n_f[m]); src K rides axis 2.
+        sel = kidx[:, None, :, :] == (kidx[:, :, None, :] + n_f[:, None, None, :])  # (M,Kd,Ks,R)
+        garr = jnp.sum(jnp.where(sel, garr[:, None, :, :], 0), axis=2)
+        garr = jnp.where(jnp.any(sel, axis=2), garr, inf)
+        gcnt = jnp.sum(jnp.where(sel, gcnt[:, None, :, :], 0), axis=2)
+
+        # Best published chain, first-seen tiebreak (main.cpp:68-82).
+        pub = height - jnp.sum(gcnt, axis=1)  # (M, R)
+        best_h = jnp.max(pub, axis=0, keepdims=True)  # (1, R)
+        cand = pub == best_h
+        tipm = jnp.where(cand, base, inf)
+        best_tip = jnp.min(tipm, axis=0, keepdims=True)
+        winners_b = cand & (tipm == best_tip)
+        # First true along the miner axis, without a cumsum (Mosaic-friendly).
+        first_idx = jnp.min(jnp.where(winners_b, midx, m), axis=0, keepdims=True)
+        onehot_b = midx == first_idx  # (M, R)
+
+        # Reorg (simulation.h:124-142).
+        adopt = (best_h > height) & do  # (M, R)
+        oab = jnp.sum(oa * onehot_b.astype(I32)[None, :, :], axis=1)  # (M, R) own_above[:, b]
+        stale = stale + jnp.where(adopt, oab, 0)
+        oa = jnp.where(adopt[None, :, :], oab[:, None, :], oa)
+        oa = jnp.where(adopt[:, None, :], 0, oa)
+        oin_b = jnp.sum(oin * onehot_b.astype(I32)[:, None, :], axis=0)  # (M, R) own_in[b, :]
+        unpub_b = jnp.sum(height * onehot_b.astype(I32), axis=0, keepdims=True) - best_h
+        oin_bpub = oin_b - unpub_b * onehot_b.astype(I32)
+        oin = jnp.where(adopt[:, None, :], oin_bpub[None, :, :], oin)
+        height = jnp.where(adopt, best_h, height)
+        garr = jnp.where(adopt[:, None, :], inf, garr)
+        gcnt = jnp.where(adopt[:, None, :], 0, gcnt)
+        base = jnp.where(adopt, best_tip, base)
+
+        # Cut-through (main.cpp:173-182).
+        pending = jnp.where(garr > t[:, None, :], garr, inf)
+        earliest = jnp.min(pending, axis=(0, 1))[None, :]  # (1, R)
+        t = jnp.where(active, jnp.maximum(jnp.minimum(nbt, earliest), t), t)
+        return t, nbt, height, stale, base, garr, gcnt, oa, oin, ovf
+
+    carry = (
+        t_ref[...], nbt_ref[...], height_ref[...], stale_ref[...], base_ref[...],
+        garr_ref[...], gcnt_ref[...], oa_ref[...], oin_ref[...], ovf_ref[...],
+    )
+    carry = jax.lax.fori_loop(0, sb, step, carry)
+    (t_ref[...], nbt_ref[...], height_ref[...], stale_ref[...], base_ref[...],
+     garr_ref[...], gcnt_ref[...], oa_ref[...], oin_ref[...], ovf_ref[...]) = carry
+
+
+class PallasEngine(Engine):
+    """Engine with the per-chunk execution replaced by the VMEM-resident
+    Pallas kernel. Same host loop, same init/finalize, same draws — the
+    outputs are bit-identical to the scan engine on any honest fast-mode
+    config. Refuses selfish/exact configurations and device meshes (those
+    run on the scan engine).
+
+    ``tile_runs`` lanes of independent runs per grid cell (multiple of 128);
+    ``step_block`` scan steps per kernel invocation — state stays in VMEM
+    across step blocks of the same tile, bits stream in per block.
+    """
+
+    def __init__(
+        self,
+        config: SimConfig,
+        mesh=None,
+        *,
+        tile_runs: int = 512,
+        step_block: int = 64,
+        interpret: bool = False,
+    ):
+        if mesh is not None:
+            raise ValueError("PallasEngine is single-device; shard batches at the runner level")
+        if config.network.any_selfish or config.resolved_mode != "fast":
+            raise ValueError("PallasEngine implements the honest fast-mode path only")
+        if tile_runs % 128 != 0:
+            raise ValueError("tile_runs must be a multiple of 128")
+        super().__init__(config, None)
+        # The kernel consumes whole step blocks. The scan engine's auto
+        # sizing is 64-aligned on every platform; silently changing an
+        # explicitly requested chunk_steps would fork the sampling identity
+        # between platforms, so refuse instead (make_engine then falls back
+        # to the scan engine).
+        self.step_block = step_block
+        if self.chunk_steps % step_block != 0:
+            raise ValueError(
+                f"chunk_steps ({self.chunk_steps}) must be a multiple of "
+                f"step_block ({step_block}) for the pallas engine"
+            )
+        self.tile_runs = tile_runs
+        self.interpret = interpret
+
+        net = config.network
+        thr = winner_thresholds32(np.array([mc.hashrate_pct for mc in net.miners]))
+        lo = np.concatenate([[0], thr[:-1]]).astype(np.uint32)
+        self._lo = jnp.asarray(lo[:, None])
+        self._hi = jnp.asarray(thr[:, None])
+        self._prop = jnp.asarray(
+            np.array([mc.propagation_ms for mc in net.miners], np.int32)[:, None]
+        )
+        self._chunk = jax.jit(self._pallas_chunk)
+        self._scan_fallback: Engine | None = None
+
+    def scan_twin(self) -> Engine:
+        """A scan engine pinned to this engine's resolved chunk_steps — the
+        identical sampling identity, so its results are bit-for-bit what the
+        kernel would produce. The one place the pinning rule lives."""
+        if self._scan_fallback is None:
+            import dataclasses
+
+            self._scan_fallback = Engine(
+                dataclasses.replace(self.config, chunk_steps=self.chunk_steps)
+            )
+        return self._scan_fallback
+
+    def run_batch(self, keys):
+        """Tile-misaligned batches split: the aligned prefix runs on the
+        kernel, the remainder on the draw-identical scan twin."""
+        n = keys.shape[0]
+        rem = n % self.tile_runs
+        if rem == 0:
+            return super().run_batch(keys)
+        logger.info(
+            "batch of %d is not a multiple of tile_runs=%d; %d run(s) take the scan engine",
+            n, self.tile_runs, rem,
+        )
+        if n < self.tile_runs:
+            return self.scan_twin().run_batch(keys)
+        head = super().run_batch(keys[: n - rem])
+        tail = self.scan_twin().run_batch(keys[n - rem:])
+        return {k: head[k] + tail[k] for k in head}
+
+    def _pallas_chunk(self, state: SimState, cap, keys, chunk_idx, params):
+        n = cap.shape[0]
+        m, k = self.n_miners, self.config.group_slots
+        steps, sb, tile = self.chunk_steps, self.step_block, self.tile_runs
+        if n % tile != 0:
+            raise ValueError(f"batch ({n}) must be a multiple of tile_runs ({tile})")
+
+        # Same draws as the scan engine, already transposed to (steps, 2, R).
+        bits = jax.vmap(
+            lambda kk: jax.random.bits(jax.random.fold_in(kk, 1 + chunk_idx), (steps, 2), U32),
+            out_axes=2,
+        )(keys)
+
+        # SimState (runs-first) -> kernel layout (runs-last).
+        tr = lambda x: jnp.moveaxis(x, 0, -1)
+        st = (
+            state.t[None, :], state.next_block_time[None, :],
+            tr(state.height), tr(state.stale), tr(state.base_tip_arrival),
+            tr(state.group_arrival), tr(state.group_count),
+            tr(state.own_above), tr(state.own_in), state.overflow[None, :],
+        )
+
+        state_shapes = [
+            ((1, n), I32), ((1, n), I32), ((m, n), I32), ((m, n), I32), ((m, n), I32),
+            ((m, k, n), I32), ((m, k, n), I32), ((m, m, n), I32), ((m, m, n), I32),
+            ((1, n), I32),
+        ]
+
+        def tile_spec(shape):
+            block = shape[:-1] + (tile,)
+            ndim = len(shape)
+
+            def index_map(i, j, nd=ndim):
+                return (0,) * (nd - 1) + (i,)
+
+            return pl.BlockSpec(block, index_map, memory_space=pltpu.VMEM)
+
+        # self.params.mean_interval_ms is the concrete Python float; the
+        # traced `params` copy would be a captured constant in the kernel.
+        kernel = functools.partial(
+            _step_block_kernel, sb=sb, mean_interval_ms=float(self.params.mean_interval_ms)
+        )
+        grid = (n // tile, steps // sb)
+        out = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((sb, 2, tile), lambda i, j: (j, 0, i), memory_space=pltpu.VMEM),
+                tile_spec((1, n)),  # cap
+                pl.BlockSpec((m, 1), lambda i, j: (0, 0), memory_space=pltpu.VMEM),  # lo
+                pl.BlockSpec((m, 1), lambda i, j: (0, 0), memory_space=pltpu.VMEM),  # hi
+                pl.BlockSpec((m, 1), lambda i, j: (0, 0), memory_space=pltpu.VMEM),  # prop
+                *[tile_spec(s) for s, _ in state_shapes],
+            ],
+            out_specs=[tile_spec(s) for s, _ in state_shapes],
+            out_shape=[jax.ShapeDtypeStruct(s, d) for s, d in state_shapes],
+            input_output_aliases={5 + i: i for i in range(len(state_shapes))},
+            interpret=self.interpret,
+        )(bits, cap[None, :], self._lo, self._hi, self._prop, *st)
+
+        (t, nbt, height, stale, base, garr, gcnt, oa, oin, ovf) = out
+        bk = lambda x: jnp.moveaxis(x, -1, 0)
+        new_state = state._replace(
+            t=t[0], next_block_time=nbt[0],
+            height=bk(height), stale=bk(stale), base_tip_arrival=bk(base),
+            group_arrival=bk(garr), group_count=bk(gcnt),
+            own_above=bk(oa), own_in=bk(oin), overflow=ovf[0],
+        )
+        return jax.vmap(rebase)(new_state)

@@ -31,7 +31,30 @@ from .stats import SimResults
 
 logger = logging.getLogger("tpusim")
 
-__all__ = ["run_simulation_config", "make_run_keys"]
+__all__ = ["run_simulation_config", "make_run_keys", "make_engine"]
+
+
+def make_engine(config: SimConfig, mesh: Mesh | None = None, prefer_pallas: bool | None = None):
+    """Pick the fastest engine for the platform: the Pallas VMEM kernel
+    (tpusim.pallas_engine) on a single TPU device for honest fast-mode
+    configs, the scan engine otherwise. The two are draw-for-draw identical;
+    callers that hit a runtime failure in the Pallas path can rebuild a scan
+    engine pinned to the same chunk_steps and lose nothing."""
+    if prefer_pallas is None:
+        prefer_pallas = (
+            mesh is None
+            and not config.network.any_selfish
+            and config.resolved_mode == "fast"
+            and jax.devices()[0].platform == "tpu"
+        )
+    if prefer_pallas:
+        from .pallas_engine import PallasEngine
+
+        try:
+            return PallasEngine(config, mesh)
+        except ValueError:
+            logger.info("config not eligible for the pallas engine; using scan engine")
+    return Engine(config, mesh)
 
 
 def make_run_keys(seed: int, start: int, count: int) -> jax.Array:
@@ -95,7 +118,7 @@ def run_simulation_config(
     batch -= batch % n_dev or 0
     batch = max(batch, n_dev)
 
-    engine = Engine(config, mesh)
+    engine = make_engine(config, mesh)
     # A trailing remainder that doesn't fill the mesh runs on an unsharded
     # single-device engine rather than silently changing the run count.
     engine_unsharded: Engine | None = None
@@ -130,16 +153,33 @@ def run_simulation_config(
         keys = make_run_keys(config.seed, runs_done, this_batch)
 
         batch_sums = None
-        for attempt in range(max_retries + 1):
+        attempts = 0
+        while True:
             try:
                 batch_sums = this_engine.run_batch(keys)
                 break
-            except (ValueError, TypeError):
-                raise  # deterministic config errors are not transient; no retry
-            except Exception:  # noqa: BLE001 — batch-level retry is the point
-                if attempt == max_retries:
+            except Exception as e:  # noqa: BLE001 — batch-level retry is the point
+                if this_engine is engine and hasattr(this_engine, "scan_twin"):
+                    # Pallas kernel failed at compile/run time (e.g. a Mosaic
+                    # lowering gap on this TPU generation): permanently fall
+                    # back to the scan twin — same resolved chunk_steps, so
+                    # the sampling identity (and any checkpoint fingerprint)
+                    # is unchanged. Does not consume a retry attempt.
+                    logger.exception(
+                        "pallas engine failed at run %d; falling back to the scan engine",
+                        runs_done,
+                    )
+                    engine = this_engine.scan_twin()
+                    this_engine = engine
+                    continue
+                if isinstance(e, (ValueError, TypeError)):
+                    raise  # deterministic config errors are not transient; no retry
+                attempts += 1
+                if attempts > max_retries:
                     raise
-                logger.exception("batch at run %d failed (attempt %d); retrying", runs_done, attempt + 1)
+                logger.exception(
+                    "batch at run %d failed (attempt %d); retrying", runs_done, attempts
+                )
         assert batch_sums is not None
 
         if compile_s is None:
