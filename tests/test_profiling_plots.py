@@ -1,0 +1,91 @@
+"""Profiling telemetry and the analysis plots (SURVEY.md §5 subsystems)."""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+from tpusim.config import SimConfig, default_network
+from tpusim.profiling import Profiler
+from tpusim.runner import run_simulation_config
+
+
+@pytest.fixture(scope="module")
+def small_config():
+    return SimConfig(
+        network=default_network(propagation_ms=1000),
+        duration_ms=5 * 86_400_000,
+        runs=24,
+        batch_size=8,
+        seed=3,
+    )
+
+
+def test_profiler_report(small_config):
+    profiler = Profiler()
+    run_simulation_config(small_config, profiler=profiler, use_all_devices=False)
+    rep = profiler.report(small_config.duration_ms, small_config.network.block_interval_s)
+    assert rep["batches"] == 3
+    assert rep["total_runs"] == 24
+    assert rep["total_s"] > 0
+    assert rep["steady_sim_years_per_s"] > 0
+    assert rep["steady_events_per_s"] > 0
+    # First batch pays compilation; it must dominate the tiny steady batches.
+    assert rep["first_batch_s"] >= rep["total_s"] / 6
+    json.loads(profiler.report_json(small_config.duration_ms, 600.0))
+
+
+def test_profiler_trace_writes_files(tmp_path, small_config):
+    profiler = Profiler(trace_dir=str(tmp_path / "trace"))
+    with profiler.trace():
+        run_simulation_config(small_config, profiler=profiler, use_all_devices=False)
+    files = list((tmp_path / "trace").rglob("*"))
+    assert files, "jax.profiler.trace produced no output"
+
+
+def test_cli_profile_flag(capsys, tmp_path):
+    from tpusim.cli import main
+
+    rc = main(
+        [
+            "--runs", "4", "--duration-ms", "86400000", "--batch-size", "4",
+            "--quiet", "--profile",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "[profile]" in out
+    assert "steady_sim_years_per_s" in out
+
+
+def test_plots_write_pngs(tmp_path):
+    from tpusim.analysis.plots import plot_benefits, plot_stale_rates
+
+    p1 = tmp_path / "stale.png"
+    p2 = tmp_path / "bene.png"
+    plot_stale_rates(points=12, out_path=p1, simulated={1.0: [0.01] * 10})
+    plot_benefits(points=12, out_path=p2)
+    assert p1.stat().st_size > 1000
+    assert p2.stat().st_size > 1000
+
+
+def test_plots_cli(tmp_path):
+    from tpusim.analysis.plots import main
+
+    rc = main(["--out-dir", str(tmp_path), "--prop-hi-s", "20"])
+    assert rc == 0
+    assert (tmp_path / "stale_rates.png").exists()
+    assert (tmp_path / "net_benefits.png").exists()
+
+
+def test_simulate_overlay_matches_oracle():
+    from tpusim.analysis.oracle import analytical_stale_rates
+    from tpusim.analysis.plots import simulate_overlay
+
+    hashrates = (0.5, 0.3, 0.2)
+    sim = simulate_overlay(hashrates, [10.0], runs=64, duration_days=20.0, seed=5)
+    want = analytical_stale_rates(hashrates, 10.0)
+    for got, exp in zip(sim[10.0], want):
+        assert abs(got - exp) < max(0.5 * exp, 0.004), (got, exp)

@@ -1,0 +1,5 @@
+import sys
+
+from .plots import main
+
+sys.exit(main())

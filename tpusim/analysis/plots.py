@@ -1,0 +1,200 @@
+"""Stale-rate / revenue plots — the counterpart of the reference's
+``plot_stale_rate/plot.py:79-110`` figures, generalized.
+
+Two figures over a propagation-time sweep: per-miner stale rate, and relative
+revenue change after difficulty retarget. Curves come from the closed-form
+oracle (tpusim.analysis.oracle); optionally, simulated points from the TPU
+engine are overlaid at a few propagation values so the two models can be
+compared on one chart (the reference keeps them separate; the overlay is this
+framework's analytical-vs-simulated validation view made visible).
+
+Headless by default (PNG files); ``show=True`` opens interactive windows like
+the reference.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Sequence
+
+from .oracle import analytical_net_benefits, analytical_stale_rates
+
+#: The reference's 10-pool distribution (plot_stale_rate/plot.py:8-15).
+DEFAULT_POOLS = (0.30, 0.29, 0.12, 0.11, 0.08, 0.05, 0.02, 0.01, 0.01, 0.01)
+
+
+def _sweep(lo_s: float, hi_s: float, points: int) -> list[float]:
+    step = (hi_s - lo_s) / max(points - 1, 1)
+    return [lo_s + i * step for i in range(points)]
+
+
+def plot_stale_rates(
+    hashrates: Sequence[float] = DEFAULT_POOLS,
+    prop_lo_s: float = 0.1,
+    prop_hi_s: float = 60.0,
+    points: int = 120,
+    block_interval_s: float = 600.0,
+    simulated: dict[float, Sequence[float]] | None = None,
+    out_path: str | Path | None = None,
+    show: bool = False,
+):
+    """Per-miner stale rate vs propagation time (reference plot.py:79-91).
+
+    ``simulated`` maps propagation seconds -> per-miner simulated stale rates
+    to overlay as markers.
+    """
+    import matplotlib
+
+    if not show:
+        matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    xs = _sweep(prop_lo_s, prop_hi_s, points)
+    rates = [analytical_stale_rates(hashrates, x, block_interval_s) for x in xs]
+    pts = sorted(simulated.items()) if simulated else []
+    fig, ax = plt.subplots(figsize=(9, 5.5))
+    for i, h in enumerate(hashrates):
+        (line,) = ax.plot(
+            xs, [r[i] * 100 for r in rates], label=f"miner {i} ({h * 100:g}%)"
+        )
+        if pts:
+            ax.plot(
+                [p for p, _ in pts],
+                [r[i] * 100 for _, r in pts],
+                "o",
+                color=line.get_color(),
+                markersize=4,
+            )
+    ax.set_xlabel("propagation time (s)")
+    ax.set_ylabel("stale rate (%)")
+    title = "Stale rate vs propagation time (lines: closed form"
+    ax.set_title(title + (", dots: simulated)" if simulated else ")"))
+    ax.legend(fontsize=8)
+    ax.grid(True, alpha=0.3)
+    if out_path is not None:
+        fig.savefig(out_path, dpi=120, bbox_inches="tight")
+    if show:
+        plt.show()
+    return fig
+
+
+def plot_benefits(
+    hashrates: Sequence[float] = DEFAULT_POOLS,
+    prop_lo_s: float = 0.1,
+    prop_hi_s: float = 60.0,
+    points: int = 120,
+    block_interval_s: float = 600.0,
+    out_path: str | Path | None = None,
+    show: bool = False,
+):
+    """Relative revenue change vs propagation time once difficulty retargets
+    (reference plot.py:93-103): big miners gain from everyone's slow blocks."""
+    import matplotlib
+
+    if not show:
+        matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    xs = _sweep(prop_lo_s, prop_hi_s, points)
+    benefits = [analytical_net_benefits(hashrates, x, block_interval_s) for x in xs]
+    fig, ax = plt.subplots(figsize=(9, 5.5))
+    for i, h in enumerate(hashrates):
+        ax.plot(xs, [b[i] * 100 for b in benefits], label=f"miner {i} ({h * 100:g}%)")
+    ax.axhline(0.0, color="black", linewidth=0.8)
+    ax.set_xlabel("propagation time (s)")
+    ax.set_ylabel("revenue change after retarget (%)")
+    ax.set_title("Net revenue effect of propagation time")
+    ax.legend(fontsize=8)
+    ax.grid(True, alpha=0.3)
+    if out_path is not None:
+        fig.savefig(out_path, dpi=120, bbox_inches="tight")
+    if show:
+        plt.show()
+    return fig
+
+
+def simulate_overlay(
+    hashrates: Sequence[float],
+    props_s: Sequence[float],
+    runs: int = 256,
+    duration_days: float = 60.0,
+    block_interval_s: float = 600.0,
+    seed: int = 0,
+) -> dict[float, list[float]]:
+    """Simulated per-miner stale rates at the given propagation times, for
+    overlaying on the analytical curves."""
+    from ..config import MinerConfig, NetworkConfig, SimConfig
+    from ..runner import run_simulation_config
+
+    pct = [round(h * 100) for h in hashrates]
+    if sum(pct) != 100:
+        raise ValueError("hashrates must round to integer percentages summing to 100")
+    out: dict[float, list[float]] = {}
+    for prop in props_s:
+        net = NetworkConfig(
+            miners=tuple(MinerConfig(hashrate_pct=p, propagation_ms=int(prop * 1000)) for p in pct),
+            block_interval_s=block_interval_s,
+        )
+        config = SimConfig(
+            network=net,
+            duration_ms=int(duration_days * 86_400_000),
+            runs=runs,
+            batch_size=min(runs, 4096),
+            seed=seed,
+        )
+        res = run_simulation_config(config)
+        out[prop] = [m.stale_rate_mean for m in res.miners]
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="tpusim.analysis", description=__doc__)
+    p.add_argument("--out-dir", type=Path, default=Path("plots"))
+    p.add_argument("--show", action="store_true", help="open interactive windows instead")
+    p.add_argument("--prop-lo-s", type=float, default=0.1)
+    p.add_argument("--prop-hi-s", type=float, default=60.0)
+    p.add_argument("--block-interval-s", type=float, default=600.0)
+    p.add_argument(
+        "--simulate",
+        type=int,
+        default=0,
+        metavar="RUNS",
+        help="overlay simulated stale rates at a few propagation values (runs per point)",
+    )
+    args = p.parse_args(argv)
+
+    simulated = None
+    if args.simulate:
+        props = [1.0, 10.0, 30.0, 60.0]
+        simulated = simulate_overlay(DEFAULT_POOLS, props, runs=args.simulate)
+    out1 = out2 = None
+    if not args.show:
+        args.out_dir.mkdir(parents=True, exist_ok=True)
+        out1 = args.out_dir / "stale_rates.png"
+        out2 = args.out_dir / "net_benefits.png"
+    plot_stale_rates(
+        prop_lo_s=args.prop_lo_s,
+        prop_hi_s=args.prop_hi_s,
+        block_interval_s=args.block_interval_s,
+        simulated=simulated,
+        out_path=out1,
+        show=args.show,
+    )
+    plot_benefits(
+        prop_lo_s=args.prop_lo_s,
+        prop_hi_s=args.prop_hi_s,
+        block_interval_s=args.block_interval_s,
+        out_path=out2,
+        show=args.show,
+    )
+    if not args.show:
+        print(f"wrote {out1} and {out2}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -55,6 +55,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--json", type=Path, help="also write structured results to this path")
     p.add_argument("--single-device", action="store_true", help="disable multi-device sharding")
     p.add_argument("--quiet", action="store_true", help="suppress progress output")
+    p.add_argument("--profile", action="store_true", help="print phase/throughput telemetry")
+    p.add_argument(
+        "--trace-dir", type=Path, help="emit an XLA device trace here (TensorBoard format)"
+    )
     return p
 
 
@@ -96,6 +100,11 @@ def main(argv: list[str] | None = None) -> int:
                 "error: --checkpoint is only supported on the tpu backend; "
                 "the cpp oracle runs to completion in one call"
             )
+        if args.profile or args.trace_dir:
+            raise SystemExit(
+                "error: --profile/--trace-dir instrument the tpu backend; "
+                "the cpp backend reports its own elapsed time in --json output"
+            )
         from .backend.cpp import run_simulation_cpp
 
         print(f"Running {config.runs} simulations on the native C++ backend.")
@@ -114,14 +123,26 @@ def main(argv: list[str] | None = None) -> int:
         def progress(done: int, total: int) -> None:
             print(f"\r{done * 100 // total}% progress..", end="", flush=True)
 
-        results = run_simulation_config(
-            config,
-            use_all_devices=not args.single_device,
-            progress=None if args.quiet else progress,
-            checkpoint_path=args.checkpoint,
-        )
+        profiler = None
+        if args.profile or args.trace_dir:
+            from .profiling import Profiler
+
+            profiler = Profiler(trace_dir=str(args.trace_dir) if args.trace_dir else None)
+
+        from contextlib import nullcontext
+
+        with profiler.trace() if profiler else nullcontext():
+            results = run_simulation_config(
+                config,
+                use_all_devices=not args.single_device,
+                progress=None if args.quiet else progress,
+                checkpoint_path=args.checkpoint,
+                profiler=profiler,
+            )
         if not args.quiet:
             print()
+        if profiler is not None and args.profile:
+            print("[profile]", profiler.report_json(config.duration_ms, config.network.block_interval_s))
     print(results.table())
     if results.overflow_total:
         print(f"  [diagnostics: {results.overflow_total} group-slot overflows]")

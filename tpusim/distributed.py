@@ -1,0 +1,111 @@
+"""Multi-host execution over DCN — the framework's distributed backend.
+
+The reference's only parallelism is std::async threads in one process
+(reference main.cpp:195-220); SURVEY.md §2.2 maps that to the TPU-native
+stack: runs sharded over all chips of all hosts via ``shard_map`` with the
+statistics reduction as an on-device ``psum`` (ICI within a slice, DCN across
+hosts), coordinated by ``jax.distributed`` — the multi-controller JAX recipe,
+not an MPI/NCCL port. No point-to-point communication exists anywhere: runs
+are independent, so the one collective is the final reduction.
+
+Usage on each host of a multi-host TPU pod slice::
+
+    from tpusim.distributed import initialize, run_simulation_distributed
+    initialize(coordinator_address="host0:8476", num_processes=N, process_id=i)
+    results = run_simulation_distributed(config)   # identical on every host
+
+Single-process usage degrades to the plain runner (and is what the test
+suite exercises; multi-host needs real DCN-connected hosts).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .config import SimConfig
+from .runner import make_run_keys, run_simulation_config
+from .stats import SimResults
+
+logger = logging.getLogger("tpusim")
+
+__all__ = ["initialize", "global_mesh", "make_global_keys", "run_simulation_distributed"]
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Bring up the multi-controller runtime (jax.distributed over DCN).
+
+    On cloud TPU pods all three arguments are discovered from the metadata
+    server and may be omitted — a bare ``initialize()`` forwards to
+    ``jax.distributed.initialize()``'s auto-discovery. Call once per process,
+    before any other JAX call. Pass ``num_processes=1`` explicitly for a
+    single-process run; that is a no-op, so one program can serve both modes
+    with only its process-count argument changing.
+    """
+    if num_processes == 1:
+        logger.info("single-process run; jax.distributed not initialized")
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    logger.info(
+        "distributed runtime up: process %d/%d, %d global / %d local devices",
+        jax.process_index(), jax.process_count(),
+        len(jax.devices()), len(jax.local_devices()),
+    )
+
+
+def global_mesh() -> Mesh:
+    """One-axis mesh over every device of every process; the runs axis of a
+    batch is sharded across it and stat sums ride psum (ICI, then DCN)."""
+    return Mesh(np.array(jax.devices()), ("runs",))
+
+
+def make_global_keys(seed: int, start: int, count: int, mesh: Mesh) -> jax.Array:
+    """Per-run keys for a globally-sharded batch.
+
+    Under multi-controller JAX an addressable array must be assembled from
+    each process's local shard; every run keeps the same (seed, run-index)
+    key it would have in a single-process run, so results are independent of
+    the process layout (the distributed analogue of the run-order-invariant
+    reduction in the native backend).
+    """
+    sharding = NamedSharding(mesh, P("runs"))
+    if jax.process_count() == 1:
+        return jax.device_put(make_run_keys(seed, start, count), sharding)
+
+    def local_shard(index) -> np.ndarray:
+        lo = index[0].start or 0
+        hi = index[0].stop if index[0].stop is not None else count
+        return np.asarray(jax.random.key_data(make_run_keys(seed, start + lo, hi - lo)))
+
+    shape = jax.eval_shape(lambda: jax.random.key_data(make_run_keys(seed, 0, count))).shape
+    data = jax.make_array_from_callback(shape, sharding, local_shard)
+    return jax.random.wrap_key_data(data)
+
+
+def run_simulation_distributed(config: SimConfig, **kwargs) -> SimResults:
+    """Run ``config`` sharded over every device of every host.
+
+    Every process must call this with the identical config; all return the
+    identical results (psum leaves the reduced sums replicated). Batch size
+    is rounded to the global device count by the runner. Checkpointing works
+    at batch granularity exactly as in the single-host runner — on
+    preemption, restart all processes and resume.
+    """
+    mesh = global_mesh()
+    if jax.process_count() > 1 and config.runs % mesh.devices.size != 0:
+        raise ValueError(
+            f"multi-host runs ({config.runs}) must be a multiple of the global "
+            f"device count ({mesh.devices.size}) so every process sees full batches"
+        )
+    return run_simulation_config(config, mesh=mesh, **kwargs)

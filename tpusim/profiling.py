@@ -1,0 +1,87 @@
+"""Tracing / profiling subsystem.
+
+The reference's only observability is a progress percentage on stdout
+(reference main.cpp:219); SURVEY.md §5 mandates real telemetry for the TPU
+framework: compile-vs-run phase separation, steady-state throughput counters
+(sim-years/sec/chip — the headline unit of BASELINE.md), and device-level
+traces. This module provides both layers:
+
+  * ``Profiler`` — host-side phase/batch accounting. The runner enters
+    ``profiler.batch(n)`` around every device batch; the report separates the
+    first batch (which pays XLA compilation) from steady-state batches and
+    derives runs/sec, sim-years/sec and events/sec.
+  * ``Profiler.trace`` — wraps ``jax.profiler.trace`` so a sweep can emit an
+    XLA device trace (viewable in TensorBoard/XProf) without any call-site
+    knowing profiler internals. No-op unless ``trace_dir`` is set.
+
+Wired into the CLI as ``--profile`` / ``--trace-dir``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import time
+from typing import Any, Iterator
+
+
+@dataclasses.dataclass
+class BatchRecord:
+    runs: int
+    elapsed_s: float
+
+
+@dataclasses.dataclass
+class Profiler:
+    """Collects per-batch timings and derives throughput telemetry."""
+
+    trace_dir: str | None = None
+    records: list[BatchRecord] = dataclasses.field(default_factory=list)
+
+    @contextlib.contextmanager
+    def batch(self, runs: int) -> Iterator[None]:
+        # Records only successful batches: a failed attempt that the runner
+        # retries must not double-count its runs in the throughput report.
+        t0 = time.perf_counter()
+        yield
+        self.records.append(BatchRecord(runs, time.perf_counter() - t0))
+
+    @contextlib.contextmanager
+    def trace(self) -> Iterator[None]:
+        """Device-level XLA trace around the whole run (TensorBoard format)."""
+        if self.trace_dir is None:
+            yield
+            return
+        import jax
+
+        with jax.profiler.trace(self.trace_dir):
+            yield
+
+    def report(self, duration_ms: int, block_interval_s: float) -> dict[str, Any]:
+        """Phase timings + throughput. The first batch carries the jit
+        compilation (compile + first execution; JAX does not expose the split
+        without a trace); steady-state numbers use the remaining batches when
+        there are any."""
+        if not self.records:
+            return {"batches": 0}
+        total_runs = sum(r.runs for r in self.records)
+        total_s = sum(r.elapsed_s for r in self.records)
+        steady = self.records[1:] or self.records
+        steady_runs = sum(r.runs for r in steady)
+        steady_s = sum(r.elapsed_s for r in steady) or 1e-12
+        years_per_run = duration_ms / (365.2425 * 86_400_000.0)
+        events_per_run = 2.0 * duration_ms / (block_interval_s * 1000.0)
+        return {
+            "batches": len(self.records),
+            "total_runs": total_runs,
+            "total_s": round(total_s, 4),
+            "first_batch_s": round(self.records[0].elapsed_s, 4),
+            "steady_runs_per_s": round(steady_runs / steady_s, 3),
+            "steady_sim_years_per_s": round(steady_runs * years_per_run / steady_s, 3),
+            "steady_events_per_s": round(steady_runs * events_per_run / steady_s, 1),
+            "trace_dir": self.trace_dir,
+        }
+
+    def report_json(self, duration_ms: int, block_interval_s: float) -> str:
+        return json.dumps(self.report(duration_ms, block_interval_s), indent=2)

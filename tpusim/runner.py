@@ -27,6 +27,7 @@ from jax.sharding import Mesh
 
 from .config import SimConfig
 from .engine import Engine
+from .profiling import Profiler
 from .stats import SimResults
 
 logger = logging.getLogger("tpusim")
@@ -102,6 +103,7 @@ def run_simulation_config(
     progress: Callable[[int, int], None] | None = None,
     checkpoint_path: str | Path | None = None,
     max_retries: int = 2,
+    profiler: "Profiler | None" = None,
 ) -> SimResults:
     """Run ``config.runs`` simulations and aggregate their statistics.
 
@@ -150,13 +152,24 @@ def run_simulation_config(
             this_engine = engine_unsharded
         else:
             this_engine = engine
-        keys = make_run_keys(config.seed, runs_done, this_batch)
+        if mesh is not None and jax.process_count() > 1:
+            # Multi-controller: assemble the batch keys shard-by-shard so they
+            # can live on a mesh containing non-addressable devices.
+            from .distributed import make_global_keys
+
+            keys = make_global_keys(config.seed, runs_done, this_batch, mesh)
+        else:
+            keys = make_run_keys(config.seed, runs_done, this_batch)
 
         batch_sums = None
         attempts = 0
         while True:
             try:
-                batch_sums = this_engine.run_batch(keys)
+                if profiler is not None:
+                    with profiler.batch(this_batch):
+                        batch_sums = this_engine.run_batch(keys)
+                else:
+                    batch_sums = this_engine.run_batch(keys)
                 break
             except Exception as e:  # noqa: BLE001 — batch-level retry is the point
                 if this_engine is engine and hasattr(this_engine, "scan_twin"):
