@@ -107,6 +107,21 @@ def test_cpp_selfish_matches_golden(cpp_run):
     assert abs(float(np.mean(honest)) - 0.675) < 0.02
 
 
+def test_sanitized_build_and_smoke(cpp_run):
+    """The race/memory CI leg (SURVEY.md §5): build and run the native smoke
+    under ASan+UBSan and under TSan (the latter exercises the threaded
+    runner). The reference has no sanitizer coverage at all."""
+    import subprocess
+    from pathlib import Path
+
+    native = Path(__file__).resolve().parent.parent / "native"
+    proc = subprocess.run(
+        ["make", "-C", str(native), "check"], capture_output=True, text=True, timeout=600
+    )
+    assert proc.returncode == 0, f"sanitized check failed:\n{proc.stdout}\n{proc.stderr}"
+    assert proc.stdout.count("smoke ok") == 2
+
+
 def test_backend_registry_roundtrip(cpp_run):
     from tpusim.backend import get_backend
 

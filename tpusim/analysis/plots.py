@@ -17,6 +17,8 @@ from __future__ import annotations
 from pathlib import Path
 from typing import Sequence
 
+import numpy as np
+
 from .oracle import analytical_net_benefits, analytical_stale_rates
 
 #: The reference's 10-pool distribution (plot_stale_rate/plot.py:8-15).
@@ -24,8 +26,7 @@ DEFAULT_POOLS = (0.30, 0.29, 0.12, 0.11, 0.08, 0.05, 0.02, 0.01, 0.01, 0.01)
 
 
 def _sweep(lo_s: float, hi_s: float, points: int) -> list[float]:
-    step = (hi_s - lo_s) / max(points - 1, 1)
-    return [lo_s + i * step for i in range(points)]
+    return np.linspace(lo_s, hi_s, points).tolist()
 
 
 def plot_stale_rates(
@@ -75,6 +76,8 @@ def plot_stale_rates(
         fig.savefig(out_path, dpi=120, bbox_inches="tight")
     if show:
         plt.show()
+    else:
+        plt.close(fig)
     return fig
 
 
@@ -110,6 +113,8 @@ def plot_benefits(
         fig.savefig(out_path, dpi=120, bbox_inches="tight")
     if show:
         plt.show()
+    else:
+        plt.close(fig)
     return fig
 
 

@@ -13,6 +13,7 @@ sweeps, and batch-level failure retry.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import logging
@@ -165,10 +166,7 @@ def run_simulation_config(
         attempts = 0
         while True:
             try:
-                if profiler is not None:
-                    with profiler.batch(this_batch):
-                        batch_sums = this_engine.run_batch(keys)
-                else:
+                with profiler.batch(this_batch) if profiler else contextlib.nullcontext():
                     batch_sums = this_engine.run_batch(keys)
                 break
             except Exception as e:  # noqa: BLE001 — batch-level retry is the point
