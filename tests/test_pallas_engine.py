@@ -1,8 +1,9 @@
 """PallasEngine vs scan Engine: bit-identical results on shared draws.
 
 The Pallas kernel consumes the exact same threefry bits with the exact same
-step->draw mapping as the scan engine, so on any honest fast-mode config the
-two must produce *identical* statistic sums — not statistically close ones.
+step->draw mapping as the scan engine, so on any supported config — honest
+fast mode, exact mode, and exact mode with gamma=0 selfish miners — the
+two must produce *identical* statistic sums, not statistically close ones.
 Run in interpret mode on CPU (the kernel logic is pure JAX; TPU lowering is
 exercised on hardware by bench.py's engine selection)."""
 
@@ -27,14 +28,21 @@ HETERO = NetworkConfig(
 )
 
 
+SELFISH40 = default_network(
+    propagation_ms=1000, selfish_ids=(0,), hashrates=(40, 19, 12, 11, 8, 5, 3, 1, 1)
+)
+
+
 @pytest.mark.parametrize(
-    "network,duration_ms,chunk_steps",
+    "network,duration_ms,chunk_steps,mode",
     [
-        (default_network(propagation_ms=10_000), 4 * 86_400_000, 128),  # chunked, racy
-        (HETERO, 1_200_000, 64),  # heterogeneous + 0 ms propagation edge
+        (default_network(propagation_ms=10_000), 4 * 86_400_000, 128, "fast"),  # chunked, racy
+        (HETERO, 1_200_000, 64, "fast"),  # heterogeneous + 0 ms propagation edge
+        (default_network(propagation_ms=10_000), 2 * 86_400_000, 64, "exact"),  # exact honest
+        (SELFISH40, 4 * 86_400_000, 128, "exact"),  # gamma=0 selfish machinery
     ],
 )
-def test_pallas_matches_scan_engine_exactly(network, duration_ms, chunk_steps):
+def test_pallas_matches_scan_engine_exactly(network, duration_ms, chunk_steps, mode):
     # 160 runs with tile_runs=128: the aligned prefix takes the kernel, the
     # 32-run remainder takes the scan twin — both paths must agree with the
     # scan engine bit for bit.
@@ -43,7 +51,7 @@ def test_pallas_matches_scan_engine_exactly(network, duration_ms, chunk_steps):
         duration_ms=duration_ms,
         runs=160,
         batch_size=160,
-        mode="fast",
+        mode=mode,
         chunk_steps=chunk_steps,
         seed=23,
     )
@@ -64,13 +72,14 @@ def test_pallas_matches_scan_engine_exactly(network, duration_ms, chunk_steps):
             np.testing.assert_array_equal(a, b, err_msg=name)
 
 
-def test_pallas_refuses_selfish_and_mesh():
-    selfish = SimConfig(
-        network=default_network(selfish_ids=(0,), hashrates=(40, 19, 12, 11, 8, 5, 3, 1, 1)),
+def test_pallas_refuses_fast_selfish_and_mesh():
+    fast_selfish = SimConfig(
+        network=SELFISH40,
         runs=128,
+        mode="fast",  # the selfish approximation stays on the scan engine
     )
     with pytest.raises(ValueError):
-        PallasEngine(selfish)
+        PallasEngine(fast_selfish)
     honest = SimConfig(network=default_network(), runs=128)
     with pytest.raises(ValueError):
         PallasEngine(honest, mesh=object())

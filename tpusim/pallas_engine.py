@@ -21,16 +21,17 @@ every byte. This module re-expresses the same step as a Pallas kernel over a
     engine's and the two are cross-checked for exact equality in
     tests/test_pallas_engine.py.
 
-The kernel implements the honest fast-mode automaton (tpusim.state with
-``any_selfish=False``: no private counters, no reveal, pairwise own_above /
-own_in consensus bookkeeping). Selfish or exact-mode configurations stay on
-the scan engine — `PallasEngine` refuses them. Semantics contract: reference
-main.cpp:128-192 event loop, simulation.h:62-142 model, via SURVEY.md §2.1.
+Both consensus representations of tpusim.state are implemented: the pairwise
+fast mode (own_above / own_in) for honest rosters and the exact mode
+(common-prefix owner-count tensor ``cp``, private counters, the gamma=0
+reveal/race machinery) for selfish ones. The only unsupported combination is
+``mode="fast"`` forced onto a selfish roster, which stays on the scan engine.
+Semantics contract: reference main.cpp:128-192 event loop,
+simulation.h:62-174 model, via SURVEY.md §2.1.
 """
 
 from __future__ import annotations
 
-import functools
 import logging
 
 import numpy as np
@@ -57,161 +58,253 @@ logger = logging.getLogger("tpusim")
 I32 = jnp.int32
 U32 = jnp.uint32
 
+#: State leaf order in the kernel's ref lists, per mode. ``shape`` templates
+#: use M (miners), K (group slots); the trailing runs axis is implicit.
+_FAST_LEAVES = ("t", "nbt", "height", "stale", "base", "garr", "gcnt", "oa", "oin", "ovf")
+_EXACT_LEAVES = (
+    "t", "nbt", "bhp", "height", "npriv", "stale", "base", "garr", "gcnt", "cp", "ovf",
+)
 
-def _step_block_kernel(
-    # inputs streamed / revisited per grid cell
-    bits_ref,  # (SB, 2, R) uint32 — this step-block's draws
-    cap_ref,  # (1, R) int32
-    lo_ref,  # (M, 1) uint32 winner interval lower bounds
-    hi_ref,  # (M, 1) uint32 winner interval upper bounds
-    prop_ref,  # (M, 1) int32 propagation delays
-    # state input refs: copied into the output refs at the first step block
-    # of each tile (outputs are write-only until then); HBM-aliased to the
-    # outputs so the buffers are shared
-    t_in, nbt_in, height_in, stale_in, base_in,
-    garr_in, gcnt_in, oa_in, oin_in, ovf_in,
-    # state output refs (revisited: resident in VMEM across step blocks)
-    t_ref,  # (1, R) int32
-    nbt_ref,  # (1, R) int32
-    height_ref,  # (M, R) int32
-    stale_ref,  # (M, R) int32
-    base_ref,  # (M, R) int32
-    garr_ref,  # (M, K, R) int32
-    gcnt_ref,  # (M, K, R) int32
-    oa_ref,  # (M, M, R) int32 own_above
-    oin_ref,  # (M, M, R) int32 own_in
-    ovf_ref,  # (1, R) int32
-    *,
-    sb: int,
-    mean_interval_ms: float,
+
+def _leaf_shapes(m: int, k: int, exact: bool) -> list[tuple[int, ...]]:
+    if exact:
+        return [
+            (1,), (1,), (1,), (m,), (m,), (m,), (m,), (m, k), (m, k), (m, m, m), (1,),
+        ]
+    return [(1,), (1,), (m,), (m,), (m,), (m, k), (m, k), (m, m), (m, m), (1,)]
+
+
+def _make_kernel(
+    *, exact: bool, any_selfish: bool, sb: int, mean_interval_ms: float, n_state: int
 ):
-    m, k, r = garr_ref.shape
+    """Build the step-block kernel for one mode. Ref order: bits, cap, lo,
+    hi, prop, selfish, then ``n_state`` input state refs (HBM-aliased to the
+    outputs), then ``n_state`` output state refs (the live, VMEM-resident
+    copies)."""
 
-    # First step block of this run tile: seed the VMEM-resident output blocks
-    # from the inputs. They persist across the inner grid dimension (the
-    # block index depends only on the tile) and are written back once.
-    @pl.when(pl.program_id(1) == 0)
-    def _():
-        for src, dst in [
-            (t_in, t_ref), (nbt_in, nbt_ref), (height_in, height_ref),
-            (stale_in, stale_ref), (base_in, base_ref), (garr_in, garr_ref),
-            (gcnt_in, gcnt_ref), (oa_in, oa_ref), (oin_in, oin_ref),
-            (ovf_in, ovf_ref),
-        ]:
-            dst[...] = src[...]
+    def kernel(bits_ref, cap_ref, lo_ref, hi_ref, prop_ref, selfish_ref, *state_refs):
+        ins, outs = state_refs[:n_state], state_refs[n_state:]
+        names = _EXACT_LEAVES if exact else _FAST_LEAVES
 
-    cap = cap_ref[...]
-    lo = lo_ref[...]  # (M, 1) broadcasts against (M, R)
-    hi = hi_ref[...]
-    prop = prop_ref[...]
-    kidx = jax.lax.broadcasted_iota(I32, (1, k, 1), 1)  # (1, K, 1)
-    midx = jax.lax.broadcasted_iota(I32, (m, 1), 0)  # (M, 1)
-    # Literals, not captured jnp constants (pallas kernels cannot close over
-    # device arrays).
-    inf = jnp.int32(int(INF_TIME))
-    neg_gate = jnp.int32(int(NEG_TIME_CAP) - 1)
-    icap = jnp.float32(int(INTERVAL_CAP))
+        # First step block of this run tile: seed the VMEM-resident output
+        # blocks from the inputs. They persist across the inner grid
+        # dimension (their block index depends only on the tile) and are
+        # written back once.
+        @pl.when(pl.program_id(1) == 0)
+        def _():
+            for src, dst in zip(ins, outs):
+                dst[...] = src[...]
 
-    def step(s, carry):
-        t, nbt, height, stale, base, garr, gcnt, oa, oin, ovf = carry
-        bw = bits_ref[s, 0, :][None, :]  # (1, R) uint32
-        bi = bits_ref[s, 1, :][None, :]
+        m, k, _ = outs[names.index("garr")].shape
+        cap = cap_ref[...]
+        lo = lo_ref[...]  # (M, 1) broadcasts against (M, R)
+        hi = hi_ref[...]
+        prop = prop_ref[...]
+        selfish = selfish_ref[...] != 0  # (M, 1)
+        kidx = jax.lax.broadcasted_iota(I32, (1, k, 1), 1)  # (1, K, 1)
+        midx = jax.lax.broadcasted_iota(I32, (m, 1), 0)  # (M, 1)
+        # eye[i, j] for the cp contractions, built 2D (no 1D iota on TPU).
+        eye = jax.lax.broadcasted_iota(I32, (m, m), 0) == jax.lax.broadcasted_iota(
+            I32, (m, m), 1
+        )
+        # Literals, not captured jnp constants (pallas kernels cannot close
+        # over device arrays).
+        inf = jnp.int32(int(INF_TIME))
+        neg_gate = jnp.int32(int(NEG_TIME_CAP) - 1)
+        icap = jnp.float32(int(INTERVAL_CAP))
 
-        active = t < cap  # (1, R)
-        found_due = active & (t == nbt)
-        # Winner one-hot straight from the cumulative thresholds
-        # (simulation.h:213-221): miner m wins iff lo[m] <= u < hi[m]; the
-        # last interval is closed on the right, clamping the ~96/2^32
-        # overflow draws to the last miner exactly like winner_from_bits.
-        is_last = midx == m - 1  # (M, 1)
-        ow = (bw >= lo) & ((bw < hi) | is_last) & found_due  # (M, R)
-        # Interval draw (simulation.h:205-210 semantics, see tpusim.sampling).
-        u = (bi >> U32(8)).astype(jnp.float32) * jnp.float32(2.0**-24)
-        dt = jnp.minimum(-jnp.log1p(-u) * jnp.float32(mean_interval_ms), icap).astype(I32)
+        def push_groups(garr, gcnt, arrival, count, do):
+            """Append an (arrival, count) group per miner where ``do`` is set
+            (tpusim.state._push_groups, runs-last). ``count`` broadcasts
+            against (M, R). Returns (garr, gcnt, overflow_increment)."""
+            n = jnp.sum((gcnt > 0).astype(I32), axis=1)  # (M, R)
+            last_idx = jnp.maximum(n - 1, 0)
+            onehot_last = kidx == last_idx[:, None, :]  # (M, K, R)
+            last_arr = jnp.sum(jnp.where(onehot_last, garr, 0), axis=1)
+            merge = do & (n > 0) & (last_arr == arrival)
+            overflowed = do & ~merge & (n == k)
+            write_idx = jnp.where(merge | overflowed, last_idx, jnp.minimum(n, k - 1))
+            onehot_wr = (kidx == write_idx[:, None, :]) & do[:, None, :]
+            garr = jnp.where(onehot_wr, arrival[:, None, :], garr)
+            accum = (merge | overflowed)[:, None, :]
+            cnt3 = jnp.broadcast_to(count, merge.shape)[:, None, :]
+            gcnt = jnp.where(onehot_wr, jnp.where(accum, gcnt + cnt3, cnt3), gcnt)
+            return garr, gcnt, jnp.sum(overflowed.astype(I32), axis=0, keepdims=True)
 
-        # --- FoundBlock (honest: append one block arriving at t + prop).
-        arrival = t + prop  # (M, R)
-        n = jnp.sum((gcnt > 0).astype(I32), axis=1)  # (M, R)
-        last_idx = jnp.maximum(n - 1, 0)
-        onehot_last = kidx == last_idx[:, None, :]  # (M, K, R)
-        last_arr = jnp.sum(jnp.where(onehot_last, garr, 0), axis=1)
-        merge = ow & (n > 0) & (last_arr == arrival)
-        overflowed = ow & ~merge & (n == k)
-        write_idx = jnp.where(merge | overflowed, last_idx, jnp.minimum(n, k - 1))
-        onehot_wr = (kidx == write_idx[:, None, :]) & ow[:, None, :]
-        garr = jnp.where(onehot_wr, arrival[:, None, :], garr)
-        accum = (merge | overflowed)[:, None, :]
-        gcnt = jnp.where(onehot_wr, jnp.where(accum, gcnt + 1, 1), gcnt)
-        ovf = ovf + jnp.sum(overflowed.astype(I32), axis=0, keepdims=True)
-        height = height + ow.astype(I32)
-        oa = oa + (ow[:, None, :] & ~ow[None, :, :]).astype(I32)
-        oin = oin + (ow[:, None, :] & ow[None, :, :]).astype(I32)
-        nbt = jnp.where(found_due, t + dt, nbt)
+        def step(s, carry):
+            st = dict(zip(names, carry))
+            t, nbt = st["t"], st["nbt"]
+            height, stale, base = st["height"], st["stale"], st["base"]
+            garr, gcnt, ovf = st["garr"], st["gcnt"], st["ovf"]
 
-        # --- Notify sweep (flush + best chain + reorg), gated like
-        # tpusim.state.notify(do=...): a sub-NEG_TIME_CAP flush time is a
-        # no-op, and adopt is masked.
-        do = active & ~(found_due & (nbt == t))
-        t_flush = jnp.where(do, t, neg_gate)  # (1, R)
-        arrived = garr <= t_flush[:, None, :]  # (M, K, R)
-        n_f = jnp.sum(arrived.astype(I32), axis=1)  # (M, R)
-        onehot_tip = kidx == (n_f - 1)[:, None, :]
-        flushed_tip = jnp.sum(jnp.where(onehot_tip, garr, 0), axis=1)
-        base = jnp.where(n_f > 0, flushed_tip, base)
-        # Compact: shifted[m, d] = garr[m, d + n_f[m]] via a K x K one-hot
-        # sel[m, d, s] = (s == d + n_f[m]); src K rides axis 2.
-        sel = kidx[:, None, :, :] == (kidx[:, :, None, :] + n_f[:, None, None, :])  # (M,Kd,Ks,R)
-        garr = jnp.sum(jnp.where(sel, garr[:, None, :, :], 0), axis=2)
-        garr = jnp.where(jnp.any(sel, axis=2), garr, inf)
-        gcnt = jnp.sum(jnp.where(sel, gcnt[:, None, :, :], 0), axis=2)
+            bw = bits_ref[s, 0, :][None, :]  # (1, R) uint32
+            bi = bits_ref[s, 1, :][None, :]
 
-        # Best published chain, first-seen tiebreak (main.cpp:68-82).
-        pub = height - jnp.sum(gcnt, axis=1)  # (M, R)
-        best_h = jnp.max(pub, axis=0, keepdims=True)  # (1, R)
-        cand = pub == best_h
-        tipm = jnp.where(cand, base, inf)
-        best_tip = jnp.min(tipm, axis=0, keepdims=True)
-        winners_b = cand & (tipm == best_tip)
-        # First true along the miner axis, without a cumsum (Mosaic-friendly).
-        first_idx = jnp.min(jnp.where(winners_b, midx, m), axis=0, keepdims=True)
-        onehot_b = midx == first_idx  # (M, R)
+            active = t < cap  # (1, R)
+            found_due = active & (t == nbt)
+            # Winner one-hot straight from the cumulative thresholds
+            # (simulation.h:213-221): miner m wins iff lo[m] <= u < hi[m];
+            # the last interval is closed on the right, clamping the ~96/2^32
+            # overflow draws to the last miner exactly like winner_from_bits.
+            is_last = midx == m - 1  # (M, 1)
+            ow = (bw >= lo) & ((bw < hi) | is_last) & found_due  # (M, R)
+            owi = ow.astype(I32)
+            # Interval draw (simulation.h:205-210 semantics, tpusim.sampling).
+            u = (bi >> U32(8)).astype(jnp.float32) * jnp.float32(2.0**-24)
+            dt = jnp.minimum(-jnp.log1p(-u) * jnp.float32(mean_interval_ms), icap).astype(I32)
 
-        # Reorg (simulation.h:124-142).
-        adopt = (best_h > height) & do  # (M, R)
-        oab = jnp.sum(oa * onehot_b.astype(I32)[None, :, :], axis=1)  # (M, R) own_above[:, b]
-        stale = stale + jnp.where(adopt, oab, 0)
-        oa = jnp.where(adopt[None, :, :], oab[:, None, :], oa)
-        oa = jnp.where(adopt[:, None, :], 0, oa)
-        oin_b = jnp.sum(oin * onehot_b.astype(I32)[:, None, :], axis=0)  # (M, R) own_in[b, :]
-        unpub_b = jnp.sum(height * onehot_b.astype(I32), axis=0, keepdims=True) - best_h
-        oin_bpub = oin_b - unpub_b * onehot_b.astype(I32)
-        oin = jnp.where(adopt[:, None, :], oin_bpub[None, :, :], oin)
-        height = jnp.where(adopt, best_h, height)
-        garr = jnp.where(adopt[:, None, :], inf, garr)
-        gcnt = jnp.where(adopt[:, None, :], 0, gcnt)
-        base = jnp.where(adopt, best_tip, base)
+            # --- FoundBlock (simulation.h:62-76).
+            if exact:
+                npriv, bhp, cp = st["npriv"], st["bhp"], st["cp"]
+                if any_selfish:
+                    sel_w = jnp.any(ow & selfish, axis=0, keepdims=True)  # (1, R)
+                    npriv_w = jnp.sum(npriv * owi, axis=0, keepdims=True)
+                    height_w = jnp.sum(height * owi, axis=0, keepdims=True)
+                    is_race = sel_w & (npriv_w == 1) & (bhp == height_w)
+                    private_append = sel_w & ~is_race
+                    push_do = ow & ~private_append
+                    push_count = jnp.where(is_race, I32(2), I32(1))  # (1, R)
+                    npriv = npriv + jnp.where(
+                        ow,
+                        jnp.where(private_append, I32(1), jnp.where(is_race, I32(-1), I32(0))),
+                        I32(0),
+                    )
+                else:
+                    push_do = ow
+                    push_count = I32(1)
+                cp = cp + (
+                    ow[:, None, None, :] & ow[None, :, None, :] & ow[None, None, :, :]
+                ).astype(I32)
+            else:
+                push_do = ow
+                push_count = I32(1)
+                oa, oin = st["oa"], st["oin"]
+                oa = oa + (ow[:, None, :] & ~ow[None, :, :]).astype(I32)
+                oin = oin + (ow[:, None, :] & ow[None, :, :]).astype(I32)
 
-        # Cut-through (main.cpp:173-182).
-        pending = jnp.where(garr > t[:, None, :], garr, inf)
-        earliest = jnp.min(pending, axis=(0, 1))[None, :]  # (1, R)
-        t = jnp.where(active, jnp.maximum(jnp.minimum(nbt, earliest), t), t)
-        return t, nbt, height, stale, base, garr, gcnt, oa, oin, ovf
+            arrival = t + prop  # (M, R)
+            garr, gcnt, over = push_groups(garr, gcnt, arrival, push_count, push_do)
+            ovf = ovf + over
+            height = height + owi
+            nbt = jnp.where(found_due, t + dt, nbt)
 
-    carry = (
-        t_ref[...], nbt_ref[...], height_ref[...], stale_ref[...], base_ref[...],
-        garr_ref[...], gcnt_ref[...], oa_ref[...], oin_ref[...], ovf_ref[...],
-    )
-    carry = jax.lax.fori_loop(0, sb, step, carry)
-    (t_ref[...], nbt_ref[...], height_ref[...], stale_ref[...], base_ref[...],
-     garr_ref[...], gcnt_ref[...], oa_ref[...], oin_ref[...], ovf_ref[...]) = carry
+            # --- Notify sweep (flush + best + reveal + reorg), gated like
+            # tpusim.state.notify(do=...): a sub-NEG_TIME_CAP flush time is a
+            # no-op, and the reveal/adopt masks carry the gate.
+            do = active & ~(found_due & (nbt == t))
+            t_flush = jnp.where(do, t, neg_gate)  # (1, R)
+            arrived = garr <= t_flush[:, None, :]  # (M, K, R)
+            n_f = jnp.sum(arrived.astype(I32), axis=1)  # (M, R)
+            onehot_tip = kidx == (n_f - 1)[:, None, :]
+            flushed_tip = jnp.sum(jnp.where(onehot_tip, garr, 0), axis=1)
+            base = jnp.where(n_f > 0, flushed_tip, base)
+            # Compact: shifted[m, d] = garr[m, d + n_f[m]] via a K x K
+            # one-hot sel[m, d, s] = (s == d + n_f[m]); src K rides axis 2.
+            sel = kidx[:, None, :, :] == (kidx[:, :, None, :] + n_f[:, None, None, :])
+            garr = jnp.sum(jnp.where(sel, garr[:, None, :, :], 0), axis=2)
+            garr = jnp.where(jnp.any(sel, axis=2), garr, inf)
+            gcnt = jnp.sum(jnp.where(sel, gcnt[:, None, :, :], 0), axis=2)
+
+            # Best published chain, first-seen tiebreak (main.cpp:68-82).
+            pub = height - jnp.sum(gcnt, axis=1)  # (M, R)
+            if exact:
+                pub = pub - npriv
+            best_h = jnp.max(pub, axis=0, keepdims=True)  # (1, R)
+            cand = pub == best_h
+            tipm = jnp.where(cand, base, inf)
+            best_tip = jnp.min(tipm, axis=0, keepdims=True)
+            winners_b = cand & (tipm == best_tip)
+            # First true along the miner axis without a cumsum.
+            first_idx = jnp.min(jnp.where(winners_b, midx, m), axis=0, keepdims=True)
+            onehot_b = midx == first_idx  # (M, R)
+            b32 = onehot_b.astype(I32)
+
+            if exact and any_selfish:
+                # --- Selfish reveal (simulation.h:149-174), before reorg.
+                lead = height - best_h  # (M, R)
+                sc = npriv
+                can_reveal = selfish & (lead >= 0) & (sc > lead) & do
+                reveal_n = jnp.where((sc > 1) & (lead == 1), sc, sc - lead)
+                garr, gcnt, over = push_groups(garr, gcnt, t + prop, reveal_n, can_reveal)
+                ovf = ovf + over
+                npriv = jnp.where(can_reveal, sc - reveal_n, sc)
+
+            # --- Reorg (simulation.h:124-142): adopt when strictly longer
+            # than the full local chain (private blocks included).
+            adopt = (best_h > height) & do  # (M, R)
+            unpub_b = jnp.sum(height * b32, axis=0, keepdims=True) - best_h  # (1, R)
+
+            if exact:
+                # Closed-form cp update (tpusim.state.notify, exact branch).
+                ei_j = eye[:, :, None, None]  # eye over (i, j)
+                ei_o = eye[:, None, :, None]  # eye over (i, o)
+                own_self = jnp.sum(cp * (ei_j & ei_o).astype(I32), axis=(1, 2))  # (M, R)
+                cp_b_cols = jnp.sum(cp * b32[None, :, None, :], axis=1)  # (M, M, R) [i, o]
+                own_common_b = jnp.sum(cp_b_cols * eye[:, :, None].astype(I32), axis=1)
+                stale = stale + jnp.where(adopt, own_self - own_common_b, 0)
+
+                cpb = jnp.sum(cp * b32[:, None, None, :], axis=0)  # (M, M, R) [j, o]
+                cpb_bb = jnp.sum(cpb * b32[:, None, :], axis=0)  # (M, R) [o]
+                cpb_pub = cpb_bb - unpub_b * b32  # (M, R)
+                a_i = adopt[:, None, :]
+                a_j = adopt[None, :, :]
+                is_b_i = onehot_b[:, None, :]
+                is_b_j = onehot_b[None, :, :]
+                cond_pub = (a_i & (a_j | is_b_j)) | (is_b_i & a_j)  # (M, M, R)
+                cond_bj = a_i & ~a_j & ~is_b_j
+                cond_bi = ~a_i & ~is_b_i & a_j
+                cp = jnp.where(
+                    cond_pub[:, :, None, :],
+                    cpb_pub[None, None, :, :],
+                    jnp.where(
+                        cond_bj[:, :, None, :],
+                        cpb[None, :, :, :],
+                        jnp.where(cond_bi[:, :, None, :], cpb[:, None, :, :], cp),
+                    ),
+                )
+                npriv = jnp.where(adopt, 0, npriv)
+                bhp = jnp.where(do, best_h, bhp)
+            else:
+                oab = jnp.sum(oa * b32[None, :, :], axis=1)  # (M, R) own_above[:, b]
+                stale = stale + jnp.where(adopt, oab, 0)
+                oa = jnp.where(adopt[None, :, :], oab[:, None, :], oa)
+                oa = jnp.where(adopt[:, None, :], 0, oa)
+                oin_b = jnp.sum(oin * b32[:, None, :], axis=0)  # (M, R) own_in[b, :]
+                oin_bpub = oin_b - unpub_b * b32
+                oin = jnp.where(adopt[:, None, :], oin_bpub[None, :, :], oin)
+
+            height = jnp.where(adopt, best_h, height)
+            garr = jnp.where(adopt[:, None, :], inf, garr)
+            gcnt = jnp.where(adopt[:, None, :], 0, gcnt)
+            base = jnp.where(adopt, best_tip, base)
+
+            # Cut-through (main.cpp:173-182).
+            pending = jnp.where(garr > t[:, None, :], garr, inf)
+            earliest = jnp.min(pending, axis=(0, 1))[None, :]  # (1, R)
+            t = jnp.where(active, jnp.maximum(jnp.minimum(nbt, earliest), t), t)
+
+            st.update(t=t, nbt=nbt, height=height, stale=stale, base=base,
+                      garr=garr, gcnt=gcnt, ovf=ovf)
+            if exact:
+                st.update(npriv=npriv, bhp=bhp, cp=cp)
+            else:
+                st.update(oa=oa, oin=oin)
+            return tuple(st[name] for name in names)
+
+        carry = tuple(ref[...] for ref in outs)
+        carry = jax.lax.fori_loop(0, sb, step, carry)
+        for ref, val in zip(outs, carry):
+            ref[...] = val
+
+    return kernel
 
 
 class PallasEngine(Engine):
     """Engine with the per-chunk execution replaced by the VMEM-resident
     Pallas kernel. Same host loop, same init/finalize, same draws — the
-    outputs are bit-identical to the scan engine on any honest fast-mode
-    config. Refuses selfish/exact configurations and device meshes (those
-    run on the scan engine).
+    outputs are bit-identical to the scan engine on any supported config.
+    Refuses device meshes and fast-mode-with-selfish rosters (those run on
+    the scan engine).
 
     ``tile_runs`` lanes of independent runs per grid cell (multiple of 128);
     ``step_block`` scan steps per kernel invocation — state stays in VMEM
@@ -229,8 +322,11 @@ class PallasEngine(Engine):
     ):
         if mesh is not None:
             raise ValueError("PallasEngine is single-device; shard batches at the runner level")
-        if config.network.any_selfish or config.resolved_mode != "fast":
-            raise ValueError("PallasEngine implements the honest fast-mode path only")
+        if config.network.any_selfish and config.resolved_mode != "exact":
+            raise ValueError(
+                "PallasEngine needs exact mode for selfish rosters (fast-mode "
+                "selfish approximation stays on the scan engine)"
+            )
         if tile_runs % 128 != 0:
             raise ValueError("tile_runs must be a multiple of 128")
         super().__init__(config, None)
@@ -255,6 +351,9 @@ class PallasEngine(Engine):
         self._hi = jnp.asarray(thr[:, None])
         self._prop = jnp.asarray(
             np.array([mc.propagation_ms for mc in net.miners], np.int32)[:, None]
+        )
+        self._selfish = jnp.asarray(
+            np.array([mc.selfish for mc in net.miners], np.int32)[:, None]
         )
         self._chunk = jax.jit(self._pallas_chunk)
         self._scan_fallback: Engine | None = None
@@ -288,6 +387,42 @@ class PallasEngine(Engine):
         tail = self.scan_twin().run_batch(keys[n - rem:])
         return {k: head[k] + tail[k] for k in head}
 
+    def _state_to_kernel(self, state: SimState):
+        """SimState (runs-first) -> ordered runs-last leaf tuple."""
+        tr = lambda x: jnp.moveaxis(x, 0, -1)
+        if self.exact:
+            return (
+                state.t[None, :], state.next_block_time[None, :],
+                state.best_height_prev[None, :],
+                tr(state.height), tr(state.n_private), tr(state.stale),
+                tr(state.base_tip_arrival), tr(state.group_arrival),
+                tr(state.group_count), tr(state.cp), state.overflow[None, :],
+            )
+        return (
+            state.t[None, :], state.next_block_time[None, :],
+            tr(state.height), tr(state.stale), tr(state.base_tip_arrival),
+            tr(state.group_arrival), tr(state.group_count),
+            tr(state.own_above), tr(state.own_in), state.overflow[None, :],
+        )
+
+    def _state_from_kernel(self, state: SimState, out) -> SimState:
+        bk = lambda x: jnp.moveaxis(x, -1, 0)
+        if self.exact:
+            t, nbt, bhp, height, npriv, stale, base, garr, gcnt, cp, ovf = out
+            return state._replace(
+                t=t[0], next_block_time=nbt[0], best_height_prev=bhp[0],
+                height=bk(height), n_private=bk(npriv), stale=bk(stale),
+                base_tip_arrival=bk(base), group_arrival=bk(garr),
+                group_count=bk(gcnt), cp=bk(cp), overflow=ovf[0],
+            )
+        t, nbt, height, stale, base, garr, gcnt, oa, oin, ovf = out
+        return state._replace(
+            t=t[0], next_block_time=nbt[0],
+            height=bk(height), stale=bk(stale), base_tip_arrival=bk(base),
+            group_arrival=bk(garr), group_count=bk(gcnt),
+            own_above=bk(oa), own_in=bk(oin), overflow=ovf[0],
+        )
+
     def _pallas_chunk(self, state: SimState, cap, keys, chunk_idx, params):
         n = cap.shape[0]
         m, k = self.n_miners, self.config.group_slots
@@ -301,20 +436,8 @@ class PallasEngine(Engine):
             out_axes=2,
         )(keys)
 
-        # SimState (runs-first) -> kernel layout (runs-last).
-        tr = lambda x: jnp.moveaxis(x, 0, -1)
-        st = (
-            state.t[None, :], state.next_block_time[None, :],
-            tr(state.height), tr(state.stale), tr(state.base_tip_arrival),
-            tr(state.group_arrival), tr(state.group_count),
-            tr(state.own_above), tr(state.own_in), state.overflow[None, :],
-        )
-
-        state_shapes = [
-            ((1, n), I32), ((1, n), I32), ((m, n), I32), ((m, n), I32), ((m, n), I32),
-            ((m, k, n), I32), ((m, k, n), I32), ((m, m, n), I32), ((m, m, n), I32),
-            ((1, n), I32),
-        ]
+        st = self._state_to_kernel(state)
+        shapes = [s + (n,) for s in _leaf_shapes(m, k, self.exact)]
 
         def tile_spec(shape):
             block = shape[:-1] + (tile,)
@@ -325,10 +448,16 @@ class PallasEngine(Engine):
 
             return pl.BlockSpec(block, index_map, memory_space=pltpu.VMEM)
 
+        def const_spec(shape):
+            nd = len(shape)
+            return pl.BlockSpec(shape, lambda i, j, nd=nd: (0,) * nd, memory_space=pltpu.VMEM)
+
         # self.params.mean_interval_ms is the concrete Python float; the
         # traced `params` copy would be a captured constant in the kernel.
-        kernel = functools.partial(
-            _step_block_kernel, sb=sb, mean_interval_ms=float(self.params.mean_interval_ms)
+        kernel = _make_kernel(
+            exact=self.exact, any_selfish=self.any_selfish, sb=sb,
+            mean_interval_ms=float(self.params.mean_interval_ms),
+            n_state=len(shapes),
         )
         grid = (n // tile, steps // sb)
         out = pl.pallas_call(
@@ -337,23 +466,16 @@ class PallasEngine(Engine):
             in_specs=[
                 pl.BlockSpec((sb, 2, tile), lambda i, j: (j, 0, i), memory_space=pltpu.VMEM),
                 tile_spec((1, n)),  # cap
-                pl.BlockSpec((m, 1), lambda i, j: (0, 0), memory_space=pltpu.VMEM),  # lo
-                pl.BlockSpec((m, 1), lambda i, j: (0, 0), memory_space=pltpu.VMEM),  # hi
-                pl.BlockSpec((m, 1), lambda i, j: (0, 0), memory_space=pltpu.VMEM),  # prop
-                *[tile_spec(s) for s, _ in state_shapes],
+                const_spec((m, 1)),  # lo
+                const_spec((m, 1)),  # hi
+                const_spec((m, 1)),  # prop
+                const_spec((m, 1)),  # selfish
+                *[tile_spec(s) for s in shapes],
             ],
-            out_specs=[tile_spec(s) for s, _ in state_shapes],
-            out_shape=[jax.ShapeDtypeStruct(s, d) for s, d in state_shapes],
-            input_output_aliases={5 + i: i for i in range(len(state_shapes))},
+            out_specs=[tile_spec(s) for s in shapes],
+            out_shape=[jax.ShapeDtypeStruct(s, I32) for s in shapes],
+            input_output_aliases={6 + i: i for i in range(len(shapes))},
             interpret=self.interpret,
-        )(bits, cap[None, :], self._lo, self._hi, self._prop, *st)
+        )(bits, cap[None, :], self._lo, self._hi, self._prop, self._selfish, *st)
 
-        (t, nbt, height, stale, base, garr, gcnt, oa, oin, ovf) = out
-        bk = lambda x: jnp.moveaxis(x, -1, 0)
-        new_state = state._replace(
-            t=t[0], next_block_time=nbt[0],
-            height=bk(height), stale=bk(stale), base_tip_arrival=bk(base),
-            group_arrival=bk(garr), group_count=bk(gcnt),
-            own_above=bk(oa), own_in=bk(oin), overflow=ovf[0],
-        )
-        return jax.vmap(rebase)(new_state)
+        return jax.vmap(rebase)(self._state_from_kernel(state, out))

@@ -38,17 +38,15 @@ __all__ = ["run_simulation_config", "make_run_keys", "make_engine"]
 
 def make_engine(config: SimConfig, mesh: Mesh | None = None, prefer_pallas: bool | None = None):
     """Pick the fastest engine for the platform: the Pallas VMEM kernel
-    (tpusim.pallas_engine) on a single TPU device for honest fast-mode
-    configs, the scan engine otherwise. The two are draw-for-draw identical;
-    callers that hit a runtime failure in the Pallas path can rebuild a scan
-    engine pinned to the same chunk_steps and lose nothing."""
+    (tpusim.pallas_engine) on a single TPU device — fast mode for honest
+    rosters, exact mode including the selfish machinery — and the scan
+    engine otherwise (CPU, device meshes, or a fast-mode-selfish config,
+    which raises inside PallasEngine and falls through). The two are
+    draw-for-draw identical; callers that hit a runtime failure in the
+    Pallas path can rebuild a scan engine pinned to the same chunk_steps
+    and lose nothing."""
     if prefer_pallas is None:
-        prefer_pallas = (
-            mesh is None
-            and not config.network.any_selfish
-            and config.resolved_mode == "fast"
-            and jax.devices()[0].platform == "tpu"
-        )
+        prefer_pallas = mesh is None and jax.devices()[0].platform == "tpu"
     if prefer_pallas:
         from .pallas_engine import PallasEngine
 
