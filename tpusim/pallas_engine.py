@@ -267,7 +267,11 @@ def _make_kernel(
             else:
                 oab = jnp.sum(oa * b32[None, :, :], axis=1)  # (M, R) own_above[:, b]
                 stale = stale + jnp.where(adopt, oab, 0)
-                oa = jnp.where(adopt[None, :, :], oab[:, None, :], oa)
+                # b's row toward adopters gains its unpublished suffix (it
+                # sits above the adopted published prefix) — see
+                # tpusim.state.notify's fast branch.
+                col_val = oab + unpub_b * b32
+                oa = jnp.where(adopt[None, :, :], col_val[:, None, :], oa)
                 oa = jnp.where(adopt[:, None, :], 0, oa)
                 oin_b = jnp.sum(oin * b32[:, None, :], axis=0)  # (M, R) own_in[b, :]
                 oin_bpub = oin_b - unpub_b * b32

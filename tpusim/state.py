@@ -428,8 +428,13 @@ def notify(
         own_above_b = jnp.sum(own_above * b32[None, :], axis=-1)  # [M] = own_above[:, b]
         stale = state.stale + jnp.where(adopt, own_above_b, 0)
         # Adopter rows: own blocks above any lca become 0 (chain is b_pub, a
-        # prefix-free copy); columns toward adopters copy the column toward b.
-        oa = jnp.where(adopt[None, :], own_above_b[:, None], own_above)
+        # prefix-free copy). Columns toward adopters copy the column toward b
+        # — except for b's own row: the adopter holds b's *published* prefix,
+        # so b's unpublished suffix sits above the fork and must be counted
+        # (the pairwise analogue of the exact branch's cpb_pub subtraction;
+        # dropping it silently forgets b's pending blocks as future stale).
+        col_val = own_above_b + unpub_b * b32
+        oa = jnp.where(adopt[None, :], col_val[:, None], own_above)
         own_above = jnp.where(adopt[:, None], 0, oa)
         own_in_b = jnp.sum(own_in * b32[:, None], axis=0)  # [M] = own_in[b, :]
         own_in_bpub = own_in_b - unpub_b * b32

@@ -156,19 +156,36 @@ def state_from_chains(
 
 
 def canonical_view(state: SimState, t: int) -> dict:
-    """Chain-level observable facts of a SimState, for comparison."""
+    """Chain-level observable facts of a SimState, for comparison.
+
+    Group entries with ``arrival <= t`` are folded into the base tip rather
+    than listed as in-flight: a selfish reveal with 0 ms propagation stamps
+    ``arrival == t`` *after* the sweep's flush, so the entry legitimately
+    sits in the buffer until the next flush — it is already observably
+    published (every published-height/tip computation compares arrivals
+    against the current time), exactly as the reference's revealed block is
+    already counted by ``UnpublishedBlocks`` before any event processes it.
+    """
     m = state.height.shape[0]
     arrivals = []
+    base_eff = []
     for i in range(m):
         expand: list[int] = []
+        tip = int(state.base_tip_arrival[i])
         for g in range(state.group_arrival.shape[1]):
-            expand += [int(state.group_arrival[i, g])] * int(state.group_count[i, g])
+            a = int(state.group_arrival[i, g])
+            cnt = int(state.group_count[i, g])
+            if cnt and a <= t:
+                tip = a  # groups are sorted; the last arrived entry wins
+            else:
+                expand += [a] * cnt
         arrivals.append(expand)
+        base_eff.append(tip)
     return {
+        "base_tip_arrival_effective": base_eff,
         "height": np.asarray(state.height).tolist(),
         "n_private": np.asarray(state.n_private).tolist(),
         "stale": np.asarray(state.stale).tolist(),
-        "base_tip_arrival": np.asarray(state.base_tip_arrival).tolist(),
         "inflight_arrivals": arrivals,
         "cp": None if state.cp is None else np.asarray(state.cp).tolist(),
         "own_above": None if state.own_above is None else np.asarray(state.own_above).tolist(),
