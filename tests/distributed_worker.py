@@ -1,0 +1,63 @@
+"""Worker for the real multi-process jax.distributed test.
+
+Launched as a subprocess (NOT collected by pytest): one OS process per
+controller, CPU platform with 4 virtual devices each, so a 2-process run
+exercises the genuinely multi-controller paths — make_global_keys' shard
+assembly over non-addressable devices and the cross-process psum — that the
+in-process virtual-8-device tests cannot reach.
+
+Usage: python distributed_worker.py <coordinator> <num_processes> <process_id>
+Prints one line: RESULT=<json of per-miner sums + runs>.
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+).strip()
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+
+def main() -> int:
+    coordinator, num_processes, process_id = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    )
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    from tpusim.config import SimConfig, default_network
+    from tpusim.distributed import initialize, run_simulation_distributed
+
+    initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    import jax
+
+    assert jax.process_count() == num_processes, jax.process_count()
+    assert len(jax.devices()) == 4 * num_processes
+
+    config = SimConfig(
+        network=default_network(propagation_ms=1000),
+        duration_ms=5 * 86_400_000,
+        runs=32,
+        batch_size=16,  # two sharded batches of 16 (2 runs per device)
+        seed=9,
+    )
+    results = run_simulation_distributed(config)
+    payload = {
+        "process_id": process_id,
+        "runs": results.runs,
+        "blocks_found_mean": [m.blocks_found_mean for m in results.miners],
+        "blocks_share_mean": [m.blocks_share_mean for m in results.miners],
+        "stale_rate_mean": [m.stale_rate_mean for m in results.miners],
+    }
+    print("RESULT=" + json.dumps(payload), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,3 +56,90 @@ def test_distributed_equals_plain_runner():
     for ma, mb in zip(a.miners, b.miners):
         assert ma.stale_rate_mean == mb.stale_rate_mean
         assert ma.blocks_found_mean == mb.blocks_found_mean
+
+
+def test_two_process_distributed_matches_single(tmp_path):
+    """Spawn TWO real OS processes (4 virtual CPU devices each) under
+    jax.distributed: make_global_keys' non-addressable shard assembly and the
+    cross-process psum actually execute, and both controllers must return the
+    same statistics as a plain single-process run of the identical config."""
+    import json
+    import socket
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coordinator = f"127.0.0.1:{port}"
+    worker = Path(__file__).parent / "distributed_worker.py"
+    # Strip PYTHONPATH: the container's sitecustomize (/root/.axon_site)
+    # initializes the XLA backend at interpreter startup, which forbids
+    # jax.distributed.initialize in the worker. The worker adds the repo
+    # root to sys.path itself.
+    env = {
+        k: v for k, v in __import__("os").environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "PYTHONPATH")
+    }
+    # Output goes to files, not PIPEs: both workers block in collectives, so
+    # draining one worker's pipe while the other fills its 64 KB buffer could
+    # deadlock the pair until the timeout.
+    logs = []
+    procs = []
+    for i in range(2):
+        out_f = open(tmp_path / f"worker{i}.out", "w+")
+        err_f = open(tmp_path / f"worker{i}.err", "w+")
+        logs.append((out_f, err_f))
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(worker), coordinator, "2", str(i)],
+                stdout=out_f, stderr=err_f, text=True, env=env,
+            )
+        )
+    outs = []
+    try:
+        for p, (out_f, err_f) in zip(procs, logs):
+            rc = p.wait(timeout=420)
+            out_f.seek(0)
+            err_f.seek(0)
+            out, err = out_f.read(), err_f.read()
+            assert rc == 0, f"worker failed:\n{err[-3000:]}"
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for out_f, err_f in logs:
+            out_f.close()
+            err_f.close()
+
+    payloads = []
+    for out in outs:
+        lines = [l for l in out.splitlines() if l.startswith("RESULT=")]
+        assert lines, f"no RESULT line in worker output: {out[-500:]}"
+        payloads.append(json.loads(lines[0][len("RESULT="):]))
+
+    assert payloads[0]["runs"] == payloads[1]["runs"] == 32
+    for key in ("blocks_found_mean", "blocks_share_mean", "stale_rate_mean"):
+        np.testing.assert_allclose(payloads[0][key], payloads[1][key], rtol=0, atol=0)
+
+    # Same config, plain single-process runner (this process, 8-device mesh):
+    # identical statistics — the process layout must be observationally
+    # invisible (same per-run keys, same mean-of-ratios reduction).
+    config = SimConfig(
+        network=default_network(propagation_ms=1000),
+        duration_ms=5 * 86_400_000,
+        runs=32,
+        batch_size=16,
+        seed=9,
+    )
+    local = run_simulation_config(config, use_all_devices=False)
+    np.testing.assert_allclose(
+        payloads[0]["blocks_found_mean"],
+        [m.blocks_found_mean for m in local.miners], rtol=1e-12,
+    )
+    np.testing.assert_allclose(
+        payloads[0]["stale_rate_mean"],
+        [m.stale_rate_mean for m in local.miners], rtol=1e-6,
+    )

@@ -83,3 +83,28 @@ def test_pallas_refuses_fast_selfish_and_mesh():
     honest = SimConfig(network=default_network(), runs=128)
     with pytest.raises(ValueError):
         PallasEngine(honest, mesh=object())
+
+
+def test_scan_twin_shares_resolved_chunk_steps_with_auto_sizing():
+    """With chunk_steps=None and a short duration, the auto path 64-aligns the
+    resolved value possibly above the raw event bound; the scan twin pins that
+    value explicitly, and Engine's explicit-path clamp must resolve it to the
+    same number — otherwise the twin samples with a different step->key
+    identity than the kernel (and than the checkpoint fingerprint)."""
+    config = SimConfig(
+        network=default_network(propagation_ms=1000),
+        duration_ms=86_400_000,  # 1 day: raw bound ~496, aligned 512
+        runs=128,
+        batch_size=128,
+        mode="fast",
+        seed=5,
+    )
+    pallas = PallasEngine(config, tile_runs=128, step_block=64, interpret=True)
+    twin = pallas.scan_twin()
+    assert pallas.chunk_steps % 64 == 0
+    assert twin.chunk_steps == pallas.chunk_steps
+    # And a directly-built Engine with the same explicit value agrees too.
+    import dataclasses
+
+    direct = Engine(dataclasses.replace(config, chunk_steps=pallas.chunk_steps))
+    assert direct.chunk_steps == pallas.chunk_steps

@@ -27,6 +27,14 @@ DEFAULT_DURATION_MS = 12 * 2_629_746 * 1000
 #: Default number of Monte-Carlo runs (reference main.cpp:10).
 DEFAULT_RUNS = 16 * 2048
 
+#: ``mode="auto"`` keeps the fast consensus representation only while
+#: max_prop/interval stays at or below this. Fast mode's stale-count shortfall
+#: needs a compound race, ~ratio^2 per block, so the stale-rate absolute error
+#: at the boundary is ~1e-4 — the cross-validation tolerance (BASELINE.json).
+#: The reference's 10 s-propagation config (ratio 0.0167) routes to exact; the
+#: 1 s default (ratio 0.0017, error ~3e-6) keeps fast.
+FAST_MODE_MAX_RACE_RATIO = 0.01
+
 
 @dataclasses.dataclass(frozen=True)
 class MinerConfig:
@@ -98,11 +106,16 @@ class SimConfig:
     ``mode`` selects the consensus-state representation:
       * ``"exact"`` — 3-index common-prefix owner counts; observationally exact
         reorg/stale accounting for every configuration including selfish miners.
-      * ``"fast"``  — pairwise counts only; exact for honest-dominant dynamics
-        (third-party divergence deeper than a direct fork is approximated, an
-        event whose probability is O((prop/interval)^2) per race and which is
-        immaterial at the ±1e-4 stale-rate tolerance).
-      * ``"auto"``  — ``exact`` when any miner is selfish, else ``fast``.
+      * ``"fast"``  — pairwise counts only. For honest rosters every consensus
+        observable (chain contents, blocks found, shares, best height) is
+        exact; only the ``stale`` counter is approximate, and it is a provable
+        elementwise *lower bound* of the true count (see tpusim.state
+        docstring). The shortfall needs a compound-race geometry, probability
+        ~ (max_prop/interval)^2 per block, so the stale-*rate* error is below
+        the ±1e-4 tolerance whenever that ratio is below ~1e-2.
+      * ``"auto"``  — ``exact`` when any miner is selfish or when
+        ``max_prop/interval`` exceeds :data:`FAST_MODE_MAX_RACE_RATIO`
+        (fast mode's documented accuracy domain), else ``fast``.
     """
 
     network: NetworkConfig
@@ -134,10 +147,19 @@ class SimConfig:
             raise ValueError("propagation_ms must be below 2^24 ms (~4.7 h)")
 
     @property
+    def max_race_ratio(self) -> float:
+        """max propagation delay / mean block interval — the per-block race
+        probability scale that bounds fast mode's stale-count shortfall."""
+        max_prop_ms = max(m.propagation_ms for m in self.network.miners)
+        return max_prop_ms / (self.network.block_interval_s * 1000.0)
+
+    @property
     def resolved_mode(self) -> str:
         if self.mode != "auto":
             return self.mode
-        return "exact" if self.network.any_selfish else "fast"
+        if self.network.any_selfish or self.max_race_ratio > FAST_MODE_MAX_RACE_RATIO:
+            return "exact"
+        return "fast"
 
     def to_json(self) -> str:
         return json.dumps(_config_to_dict(self), indent=2)

@@ -147,15 +147,16 @@ class Engine:
         # configs don't materialize huge per-chunk RNG buffers.
         cap_bound = default_n_steps(min(int(TIME_CAP), config.duration_ms),
                                     config.network.block_interval_s)
+        # Both paths clamp against the *64-aligned* bound: the resolved value
+        # is part of the sampling identity (and of checkpoint fingerprints),
+        # so an explicit chunk_steps pinned by PallasEngine.scan_twin() — an
+        # already-aligned auto value possibly above the raw bound — must
+        # resolve to itself here, not re-clamp to a different identity.
+        align = lambda v: (v + 63) // 64 * 64
         if config.chunk_steps is None:
-            # Auto-sized chunks round up to a multiple of 64 so the resolved
-            # value — which is part of the sampling identity and of checkpoint
-            # fingerprints — is the same on every platform, including the
-            # Pallas engine whose step blocks must divide it.
-            align = lambda v: (v + 63) // 64 * 64
             self.chunk_steps = min(align(min(cap_bound, 2048)), align(bound))
         else:
-            self.chunk_steps = min(config.chunk_steps, bound)
+            self.chunk_steps = min(config.chunk_steps, align(bound))
         # Host-loop safety margin: generous vs the per-run 8-sigma bound
         # because the loop must cover the batch *max* event count; the second
         # term covers runs that freeze at TIME_CAP and re-base repeatedly.
@@ -243,6 +244,14 @@ class Engine:
 
         Host loop: jitted chunk -> re-base -> subtract elapsed from the int64
         remaining-time ledger -> repeat until every run's remaining <= 0.
+
+        The ledger is int64 HOST numpy by design (a year is 3.2e10 ms, past
+        int32, and TPUs have no fast int64); under multi-controller JAX the
+        batch arrays have non-addressable shards, so the ledger holds real
+        values only at this process's run indices, device inputs (cap, t_end)
+        are assembled shard-by-shard, and loop termination is agreed globally
+        — every process must keep calling the SPMD chunk program until ALL
+        runs everywhere finish, with its own finished runs frozen by cap=0.
         """
         n = keys.shape[0]
         duration = self.config.duration_ms
@@ -252,17 +261,49 @@ class Engine:
                 f"batch of {n} runs x {duration} ms overflows int32 block-count "
                 f"sums; lower batch_size below {int(_I32_SUM_GUARD / (blocks_bound / n))}"
             )
+        multiproc = self.mesh is not None and jax.process_count() > 1
+        if multiproc:
+            from jax.experimental import multihost_utils
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            sharding = NamedSharding(self.mesh, PartitionSpec("runs"))
+
+            def device_i32(host_arr: np.ndarray) -> jax.Array:
+                return jax.make_array_from_callback(
+                    (n,), sharding, lambda index: host_arr[index].astype(np.int32)
+                )
+
+            local_mask = np.zeros((n,), dtype=bool)
+            for dev, index in sharding.devices_indices_map((n,)).items():
+                if dev.process_index == jax.process_index():
+                    local_mask[index] = True
+
+            def ledger_update(remaining: np.ndarray, elapsed: jax.Array) -> None:
+                for shard in elapsed.addressable_shards:
+                    remaining[shard.index] -= np.asarray(shard.data, dtype=np.int64)
+
+            def all_done(remaining: np.ndarray) -> bool:
+                local = bool(np.all(remaining[local_mask] <= 0))
+                return bool(np.all(multihost_utils.process_allgather(np.array([local]))))
+        else:
+            device_i32 = lambda host_arr: jnp.asarray(host_arr.astype(np.int32))
+            def ledger_update(remaining: np.ndarray, elapsed: jax.Array) -> None:
+                remaining -= np.asarray(elapsed, dtype=np.int64)
+            all_done = lambda remaining: bool(np.all(remaining <= 0))
+
         state = self._init(keys, self.params)
+        # Multi-process: non-local entries stay at `duration` forever (their
+        # processes own them); only local indices are read or updated.
         remaining = np.full((n,), duration, dtype=np.int64)
         time_cap = np.int64(int(TIME_CAP))
 
         for chunk_idx in range(self.max_chunks):
-            cap = jnp.asarray(np.minimum(remaining, time_cap).astype(np.int32))
+            cap = device_i32(np.minimum(np.maximum(remaining, 0), time_cap))
             state, elapsed = self._chunk(
                 state, cap, keys, jnp.asarray(chunk_idx, jnp.uint32), self.params
             )
-            remaining -= np.asarray(elapsed, dtype=np.int64)
-            if np.all(remaining <= 0):
+            ledger_update(remaining, elapsed)
+            if all_done(remaining):
                 break
         else:
             raise RuntimeError(
@@ -270,7 +311,7 @@ class Engine:
                 f"{self.chunk_steps} steps — event count beyond the Poisson bound"
             )
 
-        t_end = jnp.asarray(remaining.astype(np.int32))
+        t_end = device_i32(remaining)
         sums = self._finalize(state, t_end)
         out = {k: np.asarray(v) for k, v in sums.items()}
         out["runs"] = np.int64(n)

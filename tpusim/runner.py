@@ -130,6 +130,11 @@ def run_simulation_config(
     fp_dict = json.loads(config.to_json())
     fp_dict.pop("runs", None)
     fp_dict.pop("batch_size", None)
+    # mode="auto"'s routing rules may change between versions (e.g. the
+    # race-ratio threshold); fingerprint the *resolved* representation so a
+    # resumed sweep can never silently merge fast-mode (lower-bound stale)
+    # sums with exact-mode ones.
+    fp_dict["mode"] = config.resolved_mode
     # chunk_steps=None resolves to an engine-chosen default that may change
     # between versions; fingerprint the *resolved* value, which is what fixes
     # the step->key sampling identity.
@@ -168,6 +173,15 @@ def run_simulation_config(
                     batch_sums = this_engine.run_batch(keys)
                 break
             except Exception as e:  # noqa: BLE001 — batch-level retry is the point
+                if not (this_engine is engine and hasattr(this_engine, "scan_twin")) \
+                        and isinstance(e, (ValueError, TypeError)):
+                    # Deterministic config errors (e.g. the int32 block-count
+                    # guard) are not transient: fail fast instead of retrying.
+                    # Only for non-Pallas engines — Mosaic lowering gaps often
+                    # surface as ValueError and must reach the scan_twin
+                    # fallback below (where a config error re-raises instantly:
+                    # run_batch validates before any device work).
+                    raise
                 if this_engine is engine and hasattr(this_engine, "scan_twin"):
                     # Pallas kernel failed at compile/run time (e.g. a Mosaic
                     # lowering gap on this TPU generation): permanently fall
@@ -181,8 +195,6 @@ def run_simulation_config(
                     engine = this_engine.scan_twin()
                     this_engine = engine
                     continue
-                if isinstance(e, (ValueError, TypeError)):
-                    raise  # deterministic config errors are not transient; no retry
                 attempts += 1
                 if attempts > max_retries:
                     raise

@@ -36,12 +36,28 @@ is collapsed into fixed-shape integers per (run, miner):
                             against a literal chain simulator on random runs.
 
 A cheaper pairwise variant (``own_above[i,j]``, ``own_in[i,j]``, "fast" mode)
-drops the 3-index tensor; it is exact except when a miner adopts a chain that
-contains its *own* blocks above that chain's fork point with a *third* miner
-that later wins — a multi-branch geometry with probability O((prop/interval)^2)
-per race in honest networks, far below the 1e-4 stale-rate tolerance. Selfish
-configurations route to "exact" mode automatically (deep reorgs there make the
-third-party term first-order).
+drops the 3-index tensor. Its accuracy contract, for honest rosters
+(property-tested on adversarial streams in tests/test_property_equivalence.py):
+
+  * every consensus observable is EXACT: ``own_in`` (each chain's per-owner
+    block counts, hence blocks_found / blocks_share / best_height) is
+    maintained exactly — its updates (+1 on own find; copy of the winner's
+    row minus its in-flight suffix on adopt) never consult ``own_above``;
+  * the ``stale`` counter is an elementwise LOWER BOUND of the true count.
+    Every ``own_above`` update is an exact nonneg increment, a copy of
+    another entry, or a zeroing of the adopter's row — so by induction
+    ``own_above <= truth`` elementwise, and stale increments never
+    overcount. The shortfall is realized only when an adopter's adopted
+    chain contains its own blocks above that chain's fork point with a
+    *third* miner that later wins — a compound-race geometry needing two
+    overlapping forks, probability ~ (max_prop/interval)^2 per block. At
+    the boundary of the auto-routing domain (ratio 1e-2,
+    config.FAST_MODE_MAX_RACE_RATIO) the stale-rate error is ~1e-4; at the
+    reference's 1 s-propagation default (ratio 1.7e-3) it is ~3e-6.
+
+``mode="auto"`` therefore routes selfish rosters (deep reorgs make the
+third-party term first-order) and honest rosters above the ratio threshold to
+"exact"; everything else keeps the pairwise representation.
 
 TPU-first numerics: every on-device value is 32-bit. TPUs have no native
 64-bit integer or float ALU (XLA emulates both as 32-bit pairs at a large
@@ -81,20 +97,23 @@ I64 = TIME  # back-compat alias used by tests/testing helpers
 #: Sentinel for "no arrival" (empty group slot). Strictly greater than any
 #: reachable in-chunk time. The reference uses milliseconds::max for private
 #: blocks (simulation.h:20); private blocks here are counted, not stored.
-INF_TIME = jnp.int32(2**29)
+#: np scalars, not jnp: module import must not initialize an XLA backend
+#: (jax.distributed.initialize in a worker process forbids it), and np.int32
+#: promotes identically inside traced code.
+INF_TIME = np.int32(2**29)
 
 #: A run freezes (stops advancing within the current chunk) once its relative
 #: clock passes this; the next chunk re-bases it back to 0. Bounds every time
 #: value below INF_TIME.
-TIME_CAP = jnp.int32(2**28)
+TIME_CAP = np.int32(2**28)
 
 #: Clamp on a single exponential interval draw, in ms.
-INTERVAL_CAP = jnp.int32(2**27)
+INTERVAL_CAP = np.int32(2**27)
 
 #: Re-based past tips clamp here; two competing equal-height tips can never
 #: both be this old (one block per ~10 min), so the first-seen order among
 #: live candidates is preserved.
-NEG_TIME_CAP = jnp.int32(-(2**28))
+NEG_TIME_CAP = np.int32(-(2**28))
 
 
 class SimParams(NamedTuple):
