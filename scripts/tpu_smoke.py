@@ -1,0 +1,76 @@
+"""On-TPU smoke for the Pallas engine: lower, run, cross-check vs the scan
+twin bit-for-bit, and time both. Used interactively during hardware bring-up;
+the committed artifact of these runs is PERF.md / artifacts/perf_tpu.jsonl."""
+import argparse
+import json
+import time
+
+import numpy as np
+import jax
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--runs", type=int, default=512)
+    ap.add_argument("--days", type=int, default=30)
+    ap.add_argument("--selfish", action="store_true")
+    ap.add_argument("--tile-runs", type=int, default=512)
+    ap.add_argument("--step-block", type=int, default=64)
+    ap.add_argument("--skip-scan", action="store_true")
+    args = ap.parse_args()
+
+    from tpusim import SimConfig, default_network
+    from tpusim.config import MinerConfig, NetworkConfig
+    from tpusim.pallas_engine import PallasEngine
+    from tpusim.runner import make_run_keys
+
+    print("platform:", jax.devices()[0])
+    if args.selfish:
+        net = NetworkConfig(miners=(
+            MinerConfig(hashrate_pct=40, propagation_ms=1000, selfish=True),
+            MinerConfig(hashrate_pct=30, propagation_ms=1000),
+            MinerConfig(hashrate_pct=20, propagation_ms=1000),
+            MinerConfig(hashrate_pct=10, propagation_ms=1000),
+        ))
+    else:
+        net = default_network(propagation_ms=1000)
+    cfg = SimConfig(network=net, duration_ms=args.days * 86_400_000,
+                    runs=args.runs, batch_size=args.runs, seed=7)
+    eng = PallasEngine(cfg, tile_runs=args.tile_runs, step_block=args.step_block)
+    years = args.runs * args.days / 365.2425
+
+    t0 = time.time()
+    out = eng.run_batch(make_run_keys(7, 0, args.runs))
+    print(f"pallas compile+run {time.time()-t0:.2f}s")
+    t0 = time.time()
+    out = eng.run_batch(make_run_keys(7, args.runs, args.runs))
+    dt_p = time.time() - t0
+    print(f"pallas steady {dt_p:.3f}s  ({years/dt_p:,.0f} sim-years/s)")
+
+    if args.skip_scan:
+        return
+    tw = eng.scan_twin()
+    t0 = time.time()
+    out2 = tw.run_batch(make_run_keys(7, args.runs, args.runs))
+    print(f"scan compile+run {time.time()-t0:.2f}s")
+    t0 = time.time()
+    out2 = tw.run_batch(make_run_keys(7, args.runs, args.runs))
+    dt_s = time.time() - t0
+    print(f"scan steady {dt_s:.3f}s  ({years/dt_s:,.0f} sim-years/s)")
+    print(f"pallas/scan speedup: {dt_s/dt_p:.2f}x")
+    ok = True
+    for k in out:
+        if k == "runs":
+            continue
+        same = np.array_equal(np.asarray(out[k]), np.asarray(out2[k]))
+        ok &= same
+        if not same:
+            print(k, "MISMATCH", np.asarray(out[k]), np.asarray(out2[k]))
+    print("bit-identical:", ok)
+    print(json.dumps({"pallas_sim_years_per_s": years / dt_p,
+                      "scan_sim_years_per_s": years / dt_s,
+                      "speedup": dt_s / dt_p, "bit_identical": bool(ok)}))
+
+
+if __name__ == "__main__":
+    main()

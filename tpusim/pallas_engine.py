@@ -150,7 +150,9 @@ def _make_kernel(
             ow = (bw >= lo) & ((bw < hi) | is_last) & found_due  # (M, R)
             owi = ow.astype(I32)
             # Interval draw (simulation.h:205-210 semantics, tpusim.sampling).
-            u = (bi >> U32(8)).astype(jnp.float32) * jnp.float32(2.0**-24)
+            # Mosaic has no uint32->float32 cast; after >>8 the value fits in
+            # 24 bits, so the int32 detour is exact.
+            u = (bi >> U32(8)).astype(I32).astype(jnp.float32) * jnp.float32(2.0**-24)
             dt = jnp.minimum(-jnp.log1p(-u) * jnp.float32(mean_interval_ms), icap).astype(I32)
 
             # --- FoundBlock (simulation.h:62-76).
