@@ -151,11 +151,11 @@ def main() -> int:
         smoke_rate = None
         if not args.skip_smoke:
             phase = "smoke"
-            # 512 runs on TPU: PallasEngine routes batches below tile_runs
-            # (512) wholly to its scan twin, so a smaller smoke would measure
+            # 1024 runs on TPU: PallasEngine routes batches below tile_runs
+            # (1024) wholly to its scan twin, so a smaller smoke would measure
             # — and "prove" — the wrong engine. CPU is far slower; keep its
             # smoke small (the scan engine is the only CPU engine anyway).
-            smoke_runs, smoke_days = (128, 14) if platform == "cpu" else (512, 30)
+            smoke_runs, smoke_days = (128, 14) if platform == "cpu" else (1024, 30)
             smoke_cfg = SimConfig(
                 network=default_network(propagation_ms=1000),
                 duration_ms=smoke_days * 86_400_000,
@@ -193,7 +193,10 @@ def main() -> int:
             if smoke_rate is not None:
                 # Keep the (untimed) full-batch warm-up under ~4 minutes even
                 # if the chip only ever reaches ~4x the smoke rate.
-                while batch > 512 and batch * years_per_run / (4 * smoke_rate) > 240.0:
+                # Floor at 1024 = PallasEngine's tile_runs: any smaller batch
+                # routes wholly to the scan twin and would measure the wrong
+                # engine.
+                while batch > 1024 and batch * years_per_run / (4 * smoke_rate) > 240.0:
                     batch //= 2
         info["batch_size"] = batch
 

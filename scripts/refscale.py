@@ -1,0 +1,117 @@
+"""Reference-scale reproduction driver: run the reference's README configs at
+the reference's own scale (32 768 runs x 365.2425 d, main.cpp:7-10) on a chosen
+backend and write a JSON artifact per (backend, config) into artifacts/.
+
+The committed artifacts are compared by scripts/refscale_report.py against the
+reference README tables (README.md:51-107) and against each other
+(TPU engine vs native C++ oracle) under the BASELINE.json +-1e-4 stale-rate
+criterion — the first full-scale statistical cross-validation of the
+framework.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def build_config(name: str, runs: int):
+    from tpusim import SimConfig, default_network
+    from tpusim.config import DEFAULT_DURATION_MS, MinerConfig, NetworkConfig
+
+    if name == "prop10s":
+        net = default_network(propagation_ms=10_000)
+    elif name == "prop100ms":
+        net = default_network(propagation_ms=100)
+    elif name == "default1s":
+        net = default_network(propagation_ms=1000)
+    elif name == "selfish40":
+        # README.md:89-107: miner 0 at 40%, gamma=0 selfish, everyone 1 s.
+        pcts = (40, 19, 12, 11, 8, 5, 3, 1, 1)
+        net = NetworkConfig(
+            miners=tuple(
+                MinerConfig(hashrate_pct=p, propagation_ms=1000, selfish=(i == 0))
+                for i, p in enumerate(pcts)
+            )
+        )
+    else:
+        raise SystemExit(f"unknown config {name!r}")
+    return SimConfig(
+        network=net,
+        duration_ms=DEFAULT_DURATION_MS,
+        runs=runs,
+        batch_size=8192,
+        seed=20260729,
+    )
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--backend", choices=["tpu", "native"], required=True)
+    ap.add_argument(
+        "--config", choices=["prop10s", "prop100ms", "default1s", "selfish40"],
+        required=True,
+    )
+    ap.add_argument("--runs", type=int, default=32768)
+    ap.add_argument("--out-dir", default=str(REPO / "artifacts"))
+    args = ap.parse_args()
+
+    config = build_config(args.config, args.runs)
+    out_dir = Path(args.out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    out_path = out_dir / f"refscale_{args.config}_{args.backend}.json"
+
+    t0 = time.monotonic()
+    if args.backend == "native":
+        from tpusim.backend.cpp import run_simulation_cpp
+
+        res = run_simulation_cpp(config, threads=1)
+        platform = "cpu-native"
+    else:
+        import jax
+        from tpusim.runner import run_simulation_config
+
+        platform = jax.devices()[0].platform
+        ck = out_dir / f"refscale_{args.config}_tpu.ck.npz"
+        res = run_simulation_config(
+            config, use_all_devices=False, checkpoint_path=ck,
+            progress=lambda done, total: print(f"  {done}/{total}", flush=True),
+        )
+        ck.unlink(missing_ok=True)
+    wall_s = time.monotonic() - t0
+
+    payload = {
+        "config": args.config,
+        "backend": args.backend,
+        "platform": platform,
+        "runs": res.runs,
+        "duration_ms": config.duration_ms,
+        "mode": res.mode,
+        "seed": config.seed,
+        "wall_s": round(wall_s, 2),
+        "elapsed_s": round(res.elapsed_s, 2) if res.elapsed_s else None,
+        "sim_years_per_s": round(
+            res.runs * config.duration_ms / (365.2425 * 86_400_000.0) / wall_s, 1
+        ),
+        "miners": [
+            {
+                "hashrate_pct": mc.hashrate_pct,
+                "selfish": mc.selfish,
+                "blocks_found_mean": ms.blocks_found_mean,
+                "blocks_share_mean": ms.blocks_share_mean,
+                "stale_rate_mean": ms.stale_rate_mean,
+                "stale_blocks_mean": ms.stale_blocks_mean,
+            }
+            for mc, ms in zip(config.network.miners, res.miners)
+        ],
+    }
+    out_path.write_text(json.dumps(payload, indent=2) + "\n")
+    print(json.dumps({"written": str(out_path), "wall_s": payload["wall_s"],
+                      "sim_years_per_s": payload["sim_years_per_s"]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -50,6 +50,38 @@ def test_sharded_matches_single_device():
         )
 
 
+def test_device_loop_matches_host_loop():
+    """The single-device whole-batch device loop (lax.while_loop over chunks,
+    int32-pair ledger) must be bit-identical to the per-chunk host loop (int64
+    numpy ledger) — both honest/fast and selfish/exact, across several
+    re-bases (duration > TIME_CAP would be ideal but slow; several chunks of
+    a small chunk_steps exercise the same ledger path)."""
+    selfish_net = NetworkConfig(
+        miners=(
+            MinerConfig(hashrate_pct=40, propagation_ms=1000, selfish=True),
+            MinerConfig(hashrate_pct=35, propagation_ms=1000),
+            MinerConfig(hashrate_pct=25, propagation_ms=1000),
+        )
+    )
+    for config in (
+        dataclasses.replace(SMALL, chunk_steps=64),
+        dataclasses.replace(SMALL, network=selfish_net, chunk_steps=64),
+        # 14 days > 2^30 ms: hi0 starts > 0, so the hi limb, the borrow
+        # (lo < 0 & hi > 0), and the hi*base+lo t_end reconstruction of the
+        # device ledger are all live — not just the single-limb fast path.
+        dataclasses.replace(SMALL, runs=8, batch_size=8, duration_ms=14 * 86_400_000),
+    ):
+        engine = Engine(config)
+        keys = make_run_keys(config.seed, 0, config.runs)
+        device = engine.run_batch(keys)
+        host = engine.run_batch(keys, host_loop=True)
+        assert device.keys() == host.keys()
+        for name in device:
+            np.testing.assert_array_equal(
+                np.asarray(device[name]), np.asarray(host[name]), err_msg=name
+            )
+
+
 def test_runner_remainder_batch_not_divisible_by_mesh():
     """runs % n_devices != 0: the trailing remainder runs unsharded, and the
     result equals a single-device run of the same config."""

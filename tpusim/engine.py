@@ -140,13 +140,18 @@ class Engine:
         self.exact = config.resolved_mode == "exact"
         self.any_selfish = config.network.any_selfish
         bound = default_n_steps(config.duration_ms, config.network.block_interval_s)
-        # A run freezes at TIME_CAP within a chunk regardless of steps left, so
-        # a chunk larger than one TIME_CAP span's event bound only burns scan
-        # steps on frozen runs; size the default to that span (~1249 steps at
-        # the 600 s reference interval), clamped to 2048 so short-interval
-        # configs don't materialize huge per-chunk RNG buffers.
-        cap_bound = default_n_steps(min(int(TIME_CAP), config.duration_ms),
-                                    config.network.block_interval_s)
+        # Default chunk_steps: one TIME_CAP window's MEAN event count (~2.05
+        # events per block: find + arrival flush + same-ms slack), NOT a tail
+        # bound. A run that exhausts its steps before reaching the cap simply
+        # resumes next chunk (undershoot costs one more loop iteration and a
+        # ~0.1 ms threefry), while every step past a run's cap is burned on a
+        # frozen run — so sizing to an 8-sigma bound wasted ~40% of all scan
+        # steps. The 4096 clamp keeps short-interval configs from
+        # materializing huge (steps, 2, runs) per-chunk RNG buffers.
+        mu_w = min(int(TIME_CAP), config.duration_ms) / (
+            config.network.block_interval_s * 1000.0
+        )
+        cap_mean = int(2.05 * mu_w) + 16
         # Both paths clamp against the *64-aligned* bound: the resolved value
         # is part of the sampling identity (and of checkpoint fingerprints),
         # so an explicit chunk_steps pinned by PallasEngine.scan_twin() — an
@@ -154,7 +159,7 @@ class Engine:
         # resolve to itself here, not re-clamp to a different identity.
         align = lambda v: (v + 63) // 64 * 64
         if config.chunk_steps is None:
-            self.chunk_steps = min(align(min(cap_bound, 2048)), align(bound))
+            self.chunk_steps = min(align(min(cap_mean, 4096)), align(bound))
         else:
             self.chunk_steps = min(config.chunk_steps, align(bound))
         # Host-loop safety margin: generous vs the per-run 8-sigma bound
@@ -202,11 +207,15 @@ class Engine:
 
         vinit = jax.vmap(init_fn, in_axes=(0, None))
         vchunk = jax.vmap(chunk_fn, in_axes=(0, 0, 0, None, None))
+        self._init_impl = vinit
+        self._chunk_impl = vchunk
+        self._finalize_impl = finalize_fn
 
         if mesh is None:
             self._init = jax.jit(vinit)
             self._chunk = jax.jit(vchunk)
             self._finalize = jax.jit(finalize_fn)
+            self._run_device = jax.jit(self._device_loop)
         else:
             # check_vma off: scan carries start as unvarying constants but
             # become varying over the sharded runs axis after the first step.
@@ -239,11 +248,90 @@ class Engine:
                 )
             )
 
-    def run_batch(self, keys: jax.Array) -> dict[str, np.ndarray]:
+    # Base for the on-device remaining-time ledger: remaining = hi * 2^30 + lo.
+    # A chunk's elapsed is < TIME_CAP + INTERVAL_CAP + max prop < 2^30 (one
+    # event can overshoot the cap), so one borrow per chunk suffices and the
+    # final (possibly negative) t_end fits a single int32 limb.
+    _LEDGER_BASE = 1 << 30
+
+    def _device_loop(self, keys: jax.Array, hi0: jax.Array, lo0: jax.Array,
+                     params: SimParams) -> dict[str, jax.Array]:
+        """The whole batch — init, every chunk, finalize — as ONE jitted
+        program: ``lax.while_loop`` over chunks with the int64 remaining-time
+        ledger carried as a base-2^30 int32 pair on device.
+
+        This is the single-device hot path. The per-chunk host loop of
+        :meth:`_run_batch_hostloop` costs one dispatch + host sync per chunk
+        (~90 chunks for a year-long batch), which on a tunneled TPU dominates
+        end-to-end time by an order of magnitude; here the host pays one
+        dispatch and one transfer of the final stat sums per batch.
+        """
+        state = self._init_impl(keys, params)
+        base = jnp.int32(self._LEDGER_BASE)
+        tc = jnp.int32(int(TIME_CAP))
+        limit = jnp.int32(self.max_chunks)
+
+        def cond(carry):
+            i, _, hi, lo = carry
+            return (i < limit) & jnp.any((hi > 0) | (lo > 0))
+
+        def body(carry):
+            i, state, hi, lo = carry
+            cap = jnp.maximum(jnp.where(hi > 0, tc, jnp.minimum(lo, tc)), 0)
+            state, elapsed = self._chunk_impl(
+                state, cap, keys, i.astype(jnp.uint32), params
+            )
+            lo = lo - elapsed
+            borrow = (lo < 0) & (hi > 0)
+            hi = jnp.where(borrow, hi - 1, hi)
+            lo = jnp.where(borrow, lo + base, lo)
+            return i + 1, state, hi, lo
+
+        i, state, hi, lo = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), state, hi0, lo0)
+        )
+        sums = self._finalize_impl(state, hi * base + lo)
+        sums["n_chunks"] = i
+        sums["unfinished"] = jnp.any((hi > 0) | (lo > 0))
+        return sums
+
+    def run_batch(self, keys: jax.Array, *, host_loop: bool = False) -> dict[str, np.ndarray]:
         """Simulate one batch of runs to completion; returns stat sums.
 
-        Host loop: jitted chunk -> re-base -> subtract elapsed from the int64
-        remaining-time ledger -> repeat until every run's remaining <= 0.
+        Single-device: one jitted device-resident program per batch
+        (:meth:`_device_loop`). With a mesh (or ``host_loop=True``, kept for
+        the multi-process path and for device/host-loop equivalence tests):
+        jitted chunk -> re-base -> subtract elapsed from the int64 remaining
+        ledger on the host -> repeat until every run finishes. Both paths draw
+        identically and produce bit-identical sums.
+        """
+        n = keys.shape[0]
+        duration = self.config.duration_ms
+        blocks_bound = n * (duration / (self.config.network.block_interval_s * 1000.0)) * 1.1
+        if blocks_bound > _I32_SUM_GUARD:
+            raise ValueError(
+                f"batch of {n} runs x {duration} ms overflows int32 block-count "
+                f"sums; lower batch_size below {int(_I32_SUM_GUARD / (blocks_bound / n))}"
+            )
+        if self.mesh is None and not host_loop:
+            dur = int(duration)
+            hi0 = jnp.full((n,), dur >> 30, jnp.int32)
+            lo0 = jnp.full((n,), dur & (self._LEDGER_BASE - 1), jnp.int32)
+            sums = self._run_device(keys, hi0, lo0, self.params)
+            out = {k: np.asarray(v) for k, v in sums.items()}
+            n_chunks = int(out.pop("n_chunks"))
+            if out.pop("unfinished"):
+                raise RuntimeError(
+                    f"batch did not finish within {n_chunks} chunks of "
+                    f"{self.chunk_steps} steps (limit {self.max_chunks}) — "
+                    f"event count beyond the Poisson bound"
+                )
+            out["runs"] = np.int64(n)
+            return out
+        return self._run_batch_hostloop(keys)
+
+    def _run_batch_hostloop(self, keys: jax.Array) -> dict[str, np.ndarray]:
+        """Per-chunk host loop (see :meth:`run_batch`).
 
         The ledger is int64 HOST numpy by design (a year is 3.2e10 ms, past
         int32, and TPUs have no fast int64); under multi-controller JAX the
@@ -255,12 +343,6 @@ class Engine:
         """
         n = keys.shape[0]
         duration = self.config.duration_ms
-        blocks_bound = n * (duration / (self.config.network.block_interval_s * 1000.0)) * 1.1
-        if blocks_bound > _I32_SUM_GUARD:
-            raise ValueError(
-                f"batch of {n} runs x {duration} ms overflows int32 block-count "
-                f"sums; lower batch_size below {int(_I32_SUM_GUARD / (blocks_bound / n))}"
-            )
         multiproc = self.mesh is not None and jax.process_count() > 1
         if multiproc:
             from jax.experimental import multihost_utils

@@ -322,7 +322,7 @@ class PallasEngine(Engine):
         config: SimConfig,
         mesh=None,
         *,
-        tile_runs: int = 512,
+        tile_runs: int = 1024,
         step_block: int = 64,
         interpret: bool = False,
     ):
@@ -361,7 +361,11 @@ class PallasEngine(Engine):
         self._selfish = jnp.asarray(
             np.array([mc.selfish for mc in net.miners], np.int32)[:, None]
         )
+        # Replace the scan chunk in BOTH batch paths: _chunk drives the
+        # host-loop path, _chunk_impl is what _device_loop (jitted lazily, so
+        # this assignment lands before the first trace) closes over.
         self._chunk = jax.jit(self._pallas_chunk)
+        self._chunk_impl = self._pallas_chunk
         self._scan_fallback: Engine | None = None
 
     def scan_twin(self) -> Engine:
@@ -376,21 +380,21 @@ class PallasEngine(Engine):
             )
         return self._scan_fallback
 
-    def run_batch(self, keys):
+    def run_batch(self, keys, *, host_loop: bool = False):
         """Tile-misaligned batches split: the aligned prefix runs on the
         kernel, the remainder on the draw-identical scan twin."""
         n = keys.shape[0]
         rem = n % self.tile_runs
         if rem == 0:
-            return super().run_batch(keys)
+            return super().run_batch(keys, host_loop=host_loop)
         logger.info(
             "batch of %d is not a multiple of tile_runs=%d; %d run(s) take the scan engine",
             n, self.tile_runs, rem,
         )
         if n < self.tile_runs:
-            return self.scan_twin().run_batch(keys)
-        head = super().run_batch(keys[: n - rem])
-        tail = self.scan_twin().run_batch(keys[n - rem:])
+            return self.scan_twin().run_batch(keys, host_loop=host_loop)
+        head = super().run_batch(keys[: n - rem], host_loop=host_loop)
+        tail = self.scan_twin().run_batch(keys[n - rem:], host_loop=host_loop)
         return {k: head[k] + tail[k] for k in head}
 
     def _state_to_kernel(self, state: SimState):

@@ -65,7 +65,7 @@ slowdown), so times are int32 milliseconds *relative to a per-chunk origin*:
 the engine re-bases every run's clock to 0 after each fixed-step chunk
 (:func:`rebase`), and the host tracks absolute elapsed time in int64 numpy.
 Sentinels/caps are sized so no int32 arithmetic here can overflow:
-``INF_TIME`` (2^29) > ``TIME_CAP`` (2^28, the farthest a run may advance
+``INF_TIME`` (2^30) > ``TIME_CAP`` (2^29, the farthest a run may advance
 within one chunk before freezing until the next re-base) > ``INTERVAL_CAP``
 (2^27 ms ~ 1.55 days, a clamp on single interval draws whose exceedance
 probability at the 600 s reference mean is e^-223). All cross-miner indexing
@@ -100,12 +100,14 @@ I64 = TIME  # back-compat alias used by tests/testing helpers
 #: np scalars, not jnp: module import must not initialize an XLA backend
 #: (jax.distributed.initialize in a worker process forbids it), and np.int32
 #: promotes identically inside traced code.
-INF_TIME = np.int32(2**29)
+INF_TIME = np.int32(2**30)
 
 #: A run freezes (stops advancing within the current chunk) once its relative
 #: clock passes this; the next chunk re-bases it back to 0. Bounds every time
-#: value below INF_TIME.
-TIME_CAP = np.int32(2**28)
+#: value below INF_TIME: t can overshoot the cap by at most one cut-through
+#: (INTERVAL_CAP), and arrivals sit at most max-propagation (2^24) above t,
+#: so 2^29 + 2^27 + 2*2^24 < 2^30 and nothing int32 here can overflow.
+TIME_CAP = np.int32(2**29)
 
 #: Clamp on a single exponential interval draw, in ms.
 INTERVAL_CAP = np.int32(2**27)
@@ -113,7 +115,7 @@ INTERVAL_CAP = np.int32(2**27)
 #: Re-based past tips clamp here; two competing equal-height tips can never
 #: both be this old (one block per ~10 min), so the first-seen order among
 #: live candidates is preserved.
-NEG_TIME_CAP = np.int32(-(2**28))
+NEG_TIME_CAP = np.int32(-(2**29))
 
 
 class SimParams(NamedTuple):

@@ -24,6 +24,7 @@ from .config import SimConfig
 from .state import (
     I32,
     INF_TIME,
+    TIME_CAP,
     TIME,
     SimState,
     earliest_arrival,
@@ -47,7 +48,9 @@ def drive_state_events(
     state = state._replace(next_block_time=jnp.asarray(int(intervals[0]), TIME))
     i_interval, i_winner = 1, 0
     duration = config.duration_ms
-    assert duration < 2**28, "drive_state_events runs un-rebased; keep durations < TIME_CAP"
+    assert duration < int(TIME_CAP), (
+        "drive_state_events runs un-rebased; keep durations < TIME_CAP"
+    )
 
     while int(state.t) < duration:
         found_due = int(state.t) == int(state.next_block_time)
