@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Single CI entry point: the full Python test pyramid on the forced-CPU
+# 8-virtual-device backend (tests/conftest.py) plus the native backend's
+# sanitizer legs. Run from anywhere; exits nonzero on the first red leg so
+# a failing test can never land silently.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== native: build + ASan/UBSan/TSan smoke =="
+make -C native check
+
+echo "== pytest =="
+python -m pytest tests/ -q "$@"
+
+echo "== CI green =="

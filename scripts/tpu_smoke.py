@@ -3,7 +3,11 @@ twin bit-for-bit, and time both. Used interactively during hardware bring-up;
 the committed artifact of these runs is PERF.md / artifacts/perf_tpu.jsonl."""
 import argparse
 import json
+import sys
 import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import numpy as np
 import jax

@@ -1,0 +1,30 @@
+"""Subprocess worker for the xoroshiro engine<->native bit-level A/B test.
+
+Runs in its own interpreter with JAX_ENABLE_X64=1 JAX_PLATFORMS=cpu (set by
+the parent test): float64 is required for the bit-exact interval mapping
+(tpusim.xoroshiro.interval_ms_from_word) and must not leak into the main test
+process, whose conftest configures the shared 8-virtual-device CPU backend.
+
+Prints one JSON line: the engine's raw stat sums for the config serialized in
+argv[1].
+"""
+import json
+import sys
+
+import numpy as np
+
+
+def main() -> None:
+    from tpusim.config import SimConfig
+    from tpusim.engine import Engine
+
+    config = SimConfig.from_json(sys.argv[1])
+    engine = Engine(config)
+    sums = engine.run_batch(engine.make_keys(0, config.runs))
+    print(json.dumps({
+        k: (np.asarray(v).tolist()) for k, v in sums.items()
+    }))
+
+
+if __name__ == "__main__":
+    main()

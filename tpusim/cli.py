@@ -45,6 +45,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch-size", type=int, default=4096)
     p.add_argument("--mode", choices=("auto", "exact", "fast"), default="auto")
     p.add_argument(
+        "--rng",
+        choices=("threefry", "xoroshiro"),
+        default="threefry",
+        help="sampling generator: counter-based threefry (default) or the "
+        "reference's sequential xoroshiro128++ streams, bit-compatible with "
+        "the native backend",
+    )
+    p.add_argument(
         "--backend",
         choices=("tpu", "cpp"),
         default="tpu",
@@ -84,6 +92,7 @@ def config_from_args(args: argparse.Namespace) -> SimConfig:
         seed=args.seed,
         batch_size=args.batch_size,
         mode=args.mode,
+        rng=args.rng,
     )
 
 

@@ -126,6 +126,15 @@ class SimConfig:
     group_slots: int = 4
     mode: str = "auto"
     chunk_steps: int | None = None
+    #: Sampling generator. ``"threefry"`` (default): counter-based JAX draws,
+    #: order-independent, one (winner, interval) word pair burned per scan
+    #: step. ``"xoroshiro"``: the reference's xoroshiro128++ as two sequential
+    #: per-run streams (tpusim.xoroshiro), advanced only when a draw is
+    #: consumed — bit-compatible with the native backend's generator, so tiny
+    #: configs can be A/B-checked draw-for-draw (exactly, with float64 on CPU;
+    #: on TPU the uniform->interval mapping is float32-quantized while the
+    #: generator words remain bit-exact).
+    rng: str = "threefry"
 
     def __post_init__(self) -> None:
         if self.duration_ms <= 0:
@@ -134,6 +143,8 @@ class SimConfig:
             raise ValueError("runs must be positive")
         if self.mode not in ("auto", "exact", "fast"):
             raise ValueError(f"mode must be auto|exact|fast, got {self.mode!r}")
+        if self.rng not in ("threefry", "xoroshiro"):
+            raise ValueError(f"rng must be threefry|xoroshiro, got {self.rng!r}")
         if self.group_slots < 2:
             raise ValueError("group_slots must be >= 2")
         if self.chunk_steps is not None and self.chunk_steps < 1:
@@ -189,6 +200,7 @@ def _config_to_dict(cfg: SimConfig) -> dict[str, Any]:
         "group_slots": cfg.group_slots,
         "mode": cfg.mode,
         "chunk_steps": cfg.chunk_steps,
+        "rng": cfg.rng,
     }
 
 
@@ -211,4 +223,6 @@ def _config_from_dict(d: dict[str, Any]) -> SimConfig:
         kwargs["chunk_steps"] = int(d["chunk_steps"])
     if "mode" in d:
         kwargs["mode"] = str(d["mode"])
+    if "rng" in d:
+        kwargs["rng"] = str(d["rng"])
     return SimConfig(network=network, **kwargs)

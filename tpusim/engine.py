@@ -72,12 +72,14 @@ def default_n_steps(duration_ms: int, block_interval_s: float) -> int:
     return int(2.0 * (mu + 8.0 * math.sqrt(mu + 1.0))) + 16
 
 
-def _step(
-    state: SimState, bits2: jax.Array, params: SimParams, cap: jax.Array, any_selfish: bool
+def _step_event(
+    state: SimState, w: jax.Array, dt: jax.Array, params: SimParams, cap: jax.Array,
+    any_selfish: bool,
 ) -> SimState:
-    """One event: a block find if one is due at ``t``, then the notify sweep,
-    then cut-through time advance. ``cap`` freezes the run when it passes its
-    chunk-relative end (duration reached, or TIME_CAP pending a re-base).
+    """One event given this step's (winner, interval) draws: a block find if
+    one is due at ``t``, then the notify sweep, then cut-through time advance.
+    ``cap`` freezes the run when it passes its chunk-relative end (duration
+    reached, or TIME_CAP pending a re-base).
 
     Event gating is pushed *into* the updates instead of post-hoc tree
     selects: a winner index of -1 makes ``found_block`` an exact identity, and
@@ -85,9 +87,6 @@ def _step(
     leaf is computed and written once per step.
     """
     active = state.t < cap
-    w = winner_from_bits(bits2[0], params.thresholds)
-    dt = interval_from_bits(bits2[1], params.mean_interval_ms)
-
     found_due = active & (state.t == state.next_block_time)
     state1 = found_block(state, params, jnp.where(found_due, w, jnp.int32(-1)), any_selfish)
     nbt = jnp.where(found_due, state.t + dt, state.next_block_time)
@@ -105,6 +104,42 @@ def _step(
     # could otherwise pull the min below cur_time).
     new_t = jnp.maximum(jnp.minimum(state2.next_block_time, earliest_arrival(state2)), state2.t)
     return state2._replace(t=jnp.where(active, new_t, state.t))
+
+
+def _step(
+    state: SimState, bits2: jax.Array, params: SimParams, cap: jax.Array, any_selfish: bool
+) -> SimState:
+    """Threefry step: one (winner, interval) uint32 word pair is burned per
+    scan step whether or not a find is due — that is what makes the draws
+    counter-based and order-independent (module docstring)."""
+    w = winner_from_bits(bits2[0], params.thresholds)
+    dt = interval_from_bits(bits2[1], params.mean_interval_ms)
+    return _step_event(state, w, dt, params, cap, any_selfish)
+
+
+def _step_xoro(state: SimState, xi, xw, params: SimParams, cap: jax.Array,
+               any_selfish: bool):
+    """xoroshiro128++ step: two sequential per-run streams (interval, winner)
+    advanced ONLY when the draw is consumed (a find is due this step), exactly
+    mirroring the native backend's consumption pattern
+    (native/simcore.cpp simulate_run) so tiny configs A/B bit-for-bit."""
+    from .xoroshiro import (
+        interval_ms_from_word,
+        next_words,
+        select_streams,
+        winner_from_word64,
+    )
+    from .state import INTERVAL_CAP
+
+    active = state.t < cap
+    found_due = active & (state.t == state.next_block_time)
+    xw2, wh, wl = next_words(xw)
+    w = winner_from_word64(wh, wl, params.thr64_hi, params.thr64_lo)
+    xi2, ih, il = next_words(xi)
+    dt = interval_ms_from_word(ih, il, params.mean_interval_ms, float(INTERVAL_CAP))
+    xi = select_streams(found_due, xi2, xi)
+    xw = select_streams(found_due, xw2, xw)
+    return _step_event(state, w, dt, params, cap, any_selfish), xi, xw
 
 
 # Design note (negative result, kept so it is not re-attempted): stepping one
@@ -174,25 +209,61 @@ class Engine:
         m, k, exact, steps = self.n_miners, config.group_slots, self.exact, self.chunk_steps
         any_selfish = self.any_selfish
 
-        def init_fn(run_key: jax.Array, params: SimParams) -> SimState:
-            state = init_state(m, k, exact)
-            bits = jax.random.bits(jax.random.fold_in(run_key, 0), (2,), jnp.uint32)
-            return state._replace(
-                next_block_time=interval_from_bits(bits[1], params.mean_interval_ms)
-            )
+        xoro = config.rng == "xoroshiro"
 
-        def chunk_fn(
-            state: SimState, cap: jax.Array, run_key: jax.Array, chunk_idx: jax.Array,
-            params: SimParams,
-        ) -> tuple[SimState, jax.Array]:
-            key = jax.random.fold_in(run_key, 1 + chunk_idx)
-            bits = jax.random.bits(key, (steps, 2), jnp.uint32)
+        if xoro:
+            from .state import INTERVAL_CAP
+            from .xoroshiro import interval_ms_from_word, next_words, unpack_run_streams
 
-            def body(carry: SimState, xs: jax.Array):
-                return _step(carry, xs, params, cap, any_selfish), None
+            def init_fn(packed: jax.Array, params: SimParams):
+                state = init_state(m, k, exact)
+                xi, xw = unpack_run_streams(packed)
+                # Initial next-block draw from the interval stream, like the
+                # native loop's pre-loop draw (simcore simulate_run).
+                xi, ih, il = next_words(xi)
+                nbt = interval_ms_from_word(
+                    ih, il, params.mean_interval_ms, float(INTERVAL_CAP)
+                )
+                return state._replace(next_block_time=nbt), (xi, xw)
 
-            state, _ = jax.lax.scan(body, state, bits)
-            return rebase(state)
+            def chunk_fn(
+                state: SimState, aux, cap: jax.Array, run_key: jax.Array,
+                chunk_idx: jax.Array, params: SimParams,
+            ):
+                xi, xw = aux
+
+                def body(carry, _):
+                    st, xi, xw = carry
+                    st, xi, xw = _step_xoro(st, xi, xw, params, cap, any_selfish)
+                    return (st, xi, xw), None
+
+                (state, xi, xw), _ = jax.lax.scan(
+                    body, (state, xi, xw), None, length=steps
+                )
+                state, elapsed = rebase(state)
+                return state, (xi, xw), elapsed
+        else:
+
+            def init_fn(run_key: jax.Array, params: SimParams):
+                state = init_state(m, k, exact)
+                bits = jax.random.bits(jax.random.fold_in(run_key, 0), (2,), jnp.uint32)
+                return state._replace(
+                    next_block_time=interval_from_bits(bits[1], params.mean_interval_ms)
+                ), ()
+
+            def chunk_fn(
+                state: SimState, aux, cap: jax.Array, run_key: jax.Array,
+                chunk_idx: jax.Array, params: SimParams,
+            ):
+                key = jax.random.fold_in(run_key, 1 + chunk_idx)
+                bits = jax.random.bits(key, (steps, 2), jnp.uint32)
+
+                def body(carry: SimState, xs: jax.Array):
+                    return _step(carry, xs, params, cap, any_selfish), None
+
+                state, _ = jax.lax.scan(body, state, bits)
+                state, elapsed = rebase(state)
+                return state, aux, elapsed
 
         def finalize_fn(state: SimState, t_end: jax.Array) -> dict[str, jax.Array]:
             per_run = jax.vmap(final_stats)(state, t_end)
@@ -206,7 +277,7 @@ class Engine:
             }
 
         vinit = jax.vmap(init_fn, in_axes=(0, None))
-        vchunk = jax.vmap(chunk_fn, in_axes=(0, 0, 0, None, None))
+        vchunk = jax.vmap(chunk_fn, in_axes=(0, 0, 0, 0, None, None))
         self._init_impl = vinit
         self._chunk_impl = vchunk
         self._finalize_impl = finalize_fn
@@ -223,15 +294,16 @@ class Engine:
             self._init = jax.jit(
                 shard_map(
                     vinit, mesh=mesh,
-                    in_specs=(P("runs"), rep_params), out_specs=P("runs"),
+                    in_specs=(P("runs"), rep_params),
+                    out_specs=(P("runs"), P("runs")),
                     check_vma=False,
                 )
             )
             self._chunk = jax.jit(
                 shard_map(
                     vchunk, mesh=mesh,
-                    in_specs=(P("runs"), P("runs"), P("runs"), P(), rep_params),
-                    out_specs=(P("runs"), P("runs")),
+                    in_specs=(P("runs"), P("runs"), P("runs"), P("runs"), P(), rep_params),
+                    out_specs=(P("runs"), P("runs"), P("runs")),
                     check_vma=False,
                 )
             )
@@ -247,6 +319,19 @@ class Engine:
                     check_vma=False,
                 )
             )
+
+    def make_keys(self, start: int, count: int) -> jax.Array:
+        """The per-run sampling-identity array for global run indices
+        [start, start+count) — threefry keys by default, packed xoroshiro
+        stream limbs for rng="xoroshiro". Opaque to callers: whatever this
+        returns is what :meth:`run_batch` expects as ``keys``."""
+        if self.config.rng == "xoroshiro":
+            from .xoroshiro import pack_run_streams
+
+            return jnp.asarray(pack_run_streams(self.config.seed, start, count))
+        from .runner import make_run_keys
+
+        return make_run_keys(self.config.seed, start, count)
 
     # Base for the on-device remaining-time ledger: remaining = hi * 2^30 + lo.
     # A chunk's elapsed is < TIME_CAP + INTERVAL_CAP + max prop < 2^30 (one
@@ -266,29 +351,29 @@ class Engine:
         end-to-end time by an order of magnitude; here the host pays one
         dispatch and one transfer of the final stat sums per batch.
         """
-        state = self._init_impl(keys, params)
+        state, aux = self._init_impl(keys, params)
         base = jnp.int32(self._LEDGER_BASE)
         tc = jnp.int32(int(TIME_CAP))
         limit = jnp.int32(self.max_chunks)
 
         def cond(carry):
-            i, _, hi, lo = carry
+            i, _, _, hi, lo = carry
             return (i < limit) & jnp.any((hi > 0) | (lo > 0))
 
         def body(carry):
-            i, state, hi, lo = carry
+            i, state, aux, hi, lo = carry
             cap = jnp.maximum(jnp.where(hi > 0, tc, jnp.minimum(lo, tc)), 0)
-            state, elapsed = self._chunk_impl(
-                state, cap, keys, i.astype(jnp.uint32), params
+            state, aux, elapsed = self._chunk_impl(
+                state, aux, cap, keys, i.astype(jnp.uint32), params
             )
             lo = lo - elapsed
             borrow = (lo < 0) & (hi > 0)
             hi = jnp.where(borrow, hi - 1, hi)
             lo = jnp.where(borrow, lo + base, lo)
-            return i + 1, state, hi, lo
+            return i + 1, state, aux, hi, lo
 
-        i, state, hi, lo = jax.lax.while_loop(
-            cond, body, (jnp.int32(0), state, hi0, lo0)
+        i, state, aux, hi, lo = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), state, aux, hi0, lo0)
         )
         sums = self._finalize_impl(state, hi * base + lo)
         sums["n_chunks"] = i
@@ -373,7 +458,7 @@ class Engine:
                 remaining -= np.asarray(elapsed, dtype=np.int64)
             all_done = lambda remaining: bool(np.all(remaining <= 0))
 
-        state = self._init(keys, self.params)
+        state, aux = self._init(keys, self.params)
         # Multi-process: non-local entries stay at `duration` forever (their
         # processes own them); only local indices are read or updated.
         remaining = np.full((n,), duration, dtype=np.int64)
@@ -381,8 +466,8 @@ class Engine:
 
         for chunk_idx in range(self.max_chunks):
             cap = device_i32(np.minimum(np.maximum(remaining, 0), time_cap))
-            state, elapsed = self._chunk(
-                state, cap, keys, jnp.asarray(chunk_idx, jnp.uint32), self.params
+            state, aux, elapsed = self._chunk(
+                state, aux, cap, keys, jnp.asarray(chunk_idx, jnp.uint32), self.params
             )
             ledger_update(remaining, elapsed)
             if all_done(remaining):

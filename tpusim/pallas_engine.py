@@ -103,10 +103,13 @@ def _make_kernel(
         selfish = selfish_ref[...] != 0  # (M, 1)
         kidx = jax.lax.broadcasted_iota(I32, (1, k, 1), 1)  # (1, K, 1)
         midx = jax.lax.broadcasted_iota(I32, (m, 1), 0)  # (M, 1)
-        # eye[i, j] for the cp contractions, built 2D (no 1D iota on TPU).
-        eye = jax.lax.broadcasted_iota(I32, (m, m), 0) == jax.lax.broadcasted_iota(
-            I32, (m, m), 1
-        )
+        # Identity masks for the cp contractions, built directly at their
+        # consumer ranks: Mosaic cannot shape-cast a 2D eye to 4D/3D
+        # ("infer-vector-layout: unsupported shape cast" on (M,M)->(M,M,1,1)).
+        iot = lambda shape, d: jax.lax.broadcasted_iota(I32, shape, d)
+        ei_j4 = iot((m, m, 1, 1), 0) == iot((m, m, 1, 1), 1)  # eye over (i, j)
+        ei_o4 = iot((m, 1, m, 1), 0) == iot((m, 1, m, 1), 2)  # eye over (i, o)
+        eye3 = iot((m, m, 1), 0) == iot((m, m, 1), 1)
         # Literals, not captured jnp constants (pallas kernels cannot close
         # over device arrays).
         inf = jnp.int32(int(INF_TIME))
@@ -238,11 +241,9 @@ def _make_kernel(
 
             if exact:
                 # Closed-form cp update (tpusim.state.notify, exact branch).
-                ei_j = eye[:, :, None, None]  # eye over (i, j)
-                ei_o = eye[:, None, :, None]  # eye over (i, o)
-                own_self = jnp.sum(cp * (ei_j & ei_o).astype(I32), axis=(1, 2))  # (M, R)
+                own_self = jnp.sum(cp * (ei_j4 & ei_o4).astype(I32), axis=(1, 2))  # (M, R)
                 cp_b_cols = jnp.sum(cp * b32[None, :, None, :], axis=1)  # (M, M, R) [i, o]
-                own_common_b = jnp.sum(cp_b_cols * eye[:, :, None].astype(I32), axis=1)
+                own_common_b = jnp.sum(cp_b_cols * eye3.astype(I32), axis=1)
                 stale = stale + jnp.where(adopt, own_self - own_common_b, 0)
 
                 cpb = jnp.sum(cp * b32[:, None, None, :], axis=0)  # (M, M, R) [j, o]
@@ -322,7 +323,7 @@ class PallasEngine(Engine):
         config: SimConfig,
         mesh=None,
         *,
-        tile_runs: int = 1024,
+        tile_runs: int | None = None,
         step_block: int = 64,
         interpret: bool = False,
     ):
@@ -333,6 +334,17 @@ class PallasEngine(Engine):
                 "PallasEngine needs exact mode for selfish rosters (fast-mode "
                 "selfish approximation stays on the scan engine)"
             )
+        if config.rng != "threefry":
+            raise ValueError(
+                "PallasEngine draws threefry bits outside the kernel; "
+                "rng='xoroshiro' runs on the scan engine"
+            )
+        if tile_runs is None:
+            # Measured on v5e (16 MiB scoped VMEM): fast mode fits 1024 lanes
+            # comfortably and 1024 beats 512 by ~1.6x; exact mode's
+            # (M, M, M, tile) cp tensor and its contraction temporaries blow
+            # the scoped-VMEM limit at 512 (17.4 MiB) and lower at 256.
+            tile_runs = 256 if config.resolved_mode == "exact" else 1024
         if tile_runs % 128 != 0:
             raise ValueError("tile_runs must be a multiple of 128")
         super().__init__(config, None)
@@ -433,7 +445,7 @@ class PallasEngine(Engine):
             own_above=bk(oa), own_in=bk(oin), overflow=ovf[0],
         )
 
-    def _pallas_chunk(self, state: SimState, cap, keys, chunk_idx, params):
+    def _pallas_chunk(self, state: SimState, aux, cap, keys, chunk_idx, params):
         n = cap.shape[0]
         m, k = self.n_miners, self.config.group_slots
         steps, sb, tile = self.chunk_steps, self.step_block, self.tile_runs
@@ -488,4 +500,5 @@ class PallasEngine(Engine):
             interpret=self.interpret,
         )(bits, cap[None, :], self._lo, self._hi, self._prop, self._selfish, *st)
 
-        return jax.vmap(rebase)(self._state_from_kernel(state, out))
+        new_state, elapsed = jax.vmap(rebase)(self._state_from_kernel(state, out))
+        return new_state, aux, elapsed

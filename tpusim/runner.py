@@ -159,11 +159,16 @@ def run_simulation_config(
         if mesh is not None and jax.process_count() > 1:
             # Multi-controller: assemble the batch keys shard-by-shard so they
             # can live on a mesh containing non-addressable devices.
+            if config.rng != "threefry":
+                raise NotImplementedError(
+                    "rng='xoroshiro' is a single-controller A/B mode; "
+                    "multi-process runs use the default threefry sampling"
+                )
             from .distributed import make_global_keys
 
             keys = make_global_keys(config.seed, runs_done, this_batch, mesh)
         else:
-            keys = make_run_keys(config.seed, runs_done, this_batch)
+            keys = this_engine.make_keys(runs_done, this_batch)
 
         batch_sums = None
         attempts = 0

@@ -125,15 +125,26 @@ class SimParams(NamedTuple):
     prop_ms: jax.Array  # int32 [M]
     selfish: jax.Array  # bool [M]
     mean_interval_ms: float
+    # uint32 limbs of the reference's cumulative uint64 thresholds, used by
+    # the rng="xoroshiro" draw path (bit-exact 64-bit compare on TPU).
+    thr64_hi: jax.Array = None
+    thr64_lo: jax.Array = None
 
 
 def make_params(config: SimConfig) -> SimParams:
+    from .sampling import winner_thresholds
+    from .xoroshiro import thresholds64_limbs
+
     net = config.network
+    pct = np.array([m.hashrate_pct for m in net.miners])
+    t64_hi, t64_lo = thresholds64_limbs(winner_thresholds(pct))
     return SimParams(
-        thresholds=jnp.asarray(winner_thresholds32(np.array([m.hashrate_pct for m in net.miners]))),
+        thresholds=jnp.asarray(winner_thresholds32(pct)),
         prop_ms=jnp.asarray([m.propagation_ms for m in net.miners], dtype=I32),
         selfish=jnp.asarray([m.selfish for m in net.miners], dtype=jnp.bool_),
         mean_interval_ms=net.block_interval_s * 1e3,
+        thr64_hi=jnp.asarray(t64_hi),
+        thr64_lo=jnp.asarray(t64_lo),
     )
 
 
@@ -201,7 +212,7 @@ def rebase(state: SimState) -> tuple[SimState, jax.Array]:
 
 def _at(vec: jax.Array, onehot: jax.Array) -> jax.Array:
     """vec[w] for one-hot w, as arithmetic (no gather)."""
-    return jnp.sum(jnp.where(onehot, vec, 0))
+    return jnp.sum(jnp.where(onehot, vec, 0), dtype=I32)
 
 
 def _push_groups(
@@ -222,10 +233,10 @@ def _push_groups(
     """
     m, k = arr.shape
     kidx = jnp.arange(k)[None, :]
-    n = jnp.sum((cnt > 0).astype(I32), axis=-1)  # [M]
+    n = jnp.sum((cnt > 0).astype(I32), axis=-1, dtype=I32)  # [M]
     last_idx = jnp.maximum(n - 1, 0)
     onehot_last = kidx == last_idx[:, None]
-    last_arrival = jnp.sum(jnp.where(onehot_last, arr, 0), axis=-1)
+    last_arrival = jnp.sum(jnp.where(onehot_last, arr, 0), axis=-1, dtype=I32)
     merge = do & (n > 0) & (last_arrival == new_arrival)
     overflowed = do & ~merge & (n == k)
     write_idx = jnp.where(merge | overflowed, last_idx, jnp.minimum(n, k - 1))
@@ -233,7 +244,7 @@ def _push_groups(
     arr_new = jnp.where(onehot, new_arrival[:, None], arr)
     accum = (merge | overflowed)[:, None]
     cnt_new = jnp.where(onehot, jnp.where(accum, cnt + new_count[:, None], new_count[:, None]), cnt)
-    return arr_new, cnt_new, jnp.sum(overflowed.astype(I32))
+    return arr_new, cnt_new, jnp.sum(overflowed.astype(I32), dtype=I32)
 
 
 def _flush_groups(
@@ -248,15 +259,15 @@ def _flush_groups(
     m, k = arr.shape
     kidx = jnp.arange(k)
     arrived = arr <= t
-    n_f = jnp.sum(arrived.astype(I32), axis=-1)
+    n_f = jnp.sum(arrived.astype(I32), axis=-1, dtype=I32)
     onehot_tip = kidx[None, :] == (n_f - 1)[:, None]
-    flushed_tip = jnp.sum(jnp.where(onehot_tip, arr, 0), axis=-1)
+    flushed_tip = jnp.sum(jnp.where(onehot_tip, arr, 0), axis=-1, dtype=I32)
     new_base = jnp.where(n_f > 0, flushed_tip, base_tip)
     # shifted[m, j] = arr[m, j + n_f[m]]; slots past the end become empty.
     sel = kidx[None, None, :] == (kidx[None, :, None] + n_f[:, None, None])  # [M, K_dst, K_src]
-    arr_new = jnp.sum(jnp.where(sel, arr[:, None, :], 0), axis=-1)
+    arr_new = jnp.sum(jnp.where(sel, arr[:, None, :], 0), axis=-1, dtype=I32)
     arr_new = jnp.where(jnp.any(sel, axis=-1), arr_new, INF_TIME)
-    cnt_new = jnp.sum(jnp.where(sel, cnt[:, None, :], 0), axis=-1)
+    cnt_new = jnp.sum(jnp.where(sel, cnt[:, None, :], 0), axis=-1, dtype=I32)
     return arr_new, cnt_new, new_base
 
 
@@ -346,7 +357,7 @@ def _best_chain(
     Ties on both height and tip arrival resolve to the lowest miner index,
     matching the reference's scan order with strict comparisons.
     """
-    pub_height = height - n_private - jnp.sum(group_count, axis=-1)
+    pub_height = height - n_private - jnp.sum(group_count, axis=-1, dtype=I32)
     best_h = jnp.max(pub_height)
     cand = pub_height == best_h
     tip_masked = jnp.where(cand, tip, INF_TIME)
@@ -418,16 +429,16 @@ def notify(
     if cp is not None:
         eye = jnp.eye(m, dtype=I32)
         # cp[i, i, i]: own blocks in own chain.
-        own_self = jnp.sum(cp * eye[:, :, None] * eye[:, None, :], axis=(1, 2))
+        own_self = jnp.sum(cp * eye[:, :, None] * eye[:, None, :], axis=(1, 2), dtype=I32)
         # cp[i, b, i]: own blocks in the common prefix with b.
-        cp_b_cols = jnp.sum(cp * b32[None, :, None], axis=1)  # [i, o] = cp[i, b, o]
-        own_common_b = jnp.sum(cp_b_cols * eye, axis=1)
+        cp_b_cols = jnp.sum(cp * b32[None, :, None], axis=1, dtype=I32)  # [i, o] = cp[i, b, o]
+        own_common_b = jnp.sum(cp_b_cols * eye, axis=1, dtype=I32)
         stale = state.stale + jnp.where(adopt, own_self - own_common_b, 0)
 
         # Closed-form cp update: every adopter's chain becomes b's published
         # chain; see module docstring for the case analysis.
-        cpb = jnp.sum(cp * b32[:, None, None], axis=0)  # [M, M]: cp[b, j, o]
-        cpb_bb = jnp.sum(cpb * b32[:, None], axis=0)  # [M]: cp[b, b, o]
+        cpb = jnp.sum(cp * b32[:, None, None], axis=0, dtype=I32)  # [M, M]: cp[b, j, o]
+        cpb_bb = jnp.sum(cpb * b32[:, None], axis=0, dtype=I32)  # [M]: cp[b, b, o]
         cpb_pub = cpb_bb - unpub_b * b32
         is_b_i = onehot_b[:, None]
         is_b_j = onehot_b[None, :]
@@ -446,7 +457,7 @@ def notify(
             ),
         )
     else:
-        own_above_b = jnp.sum(own_above * b32[None, :], axis=-1)  # [M] = own_above[:, b]
+        own_above_b = jnp.sum(own_above * b32[None, :], axis=-1, dtype=I32)  # [M] = own_above[:, b]
         stale = state.stale + jnp.where(adopt, own_above_b, 0)
         # Adopter rows: own blocks above any lca become 0 (chain is b_pub, a
         # prefix-free copy). Columns toward adopters copy the column toward b
@@ -457,7 +468,7 @@ def notify(
         col_val = own_above_b + unpub_b * b32
         oa = jnp.where(adopt[None, :], col_val[:, None], own_above)
         own_above = jnp.where(adopt[:, None], 0, oa)
-        own_in_b = jnp.sum(own_in * b32[:, None], axis=0)  # [M] = own_in[b, :]
+        own_in_b = jnp.sum(own_in * b32[:, None], axis=0, dtype=I32)  # [M] = own_in[b, :]
         own_in_bpub = own_in_b - unpub_b * b32
         own_in = jnp.where(adopt[:, None], own_in_bpub[None, :], own_in)
 
@@ -499,7 +510,7 @@ def final_stats(state: SimState, t_end: jax.Array) -> dict[str, jax.Array]:
     the reference (main.cpp:214-216,230-231).
     """
     m = state.height.shape[0]
-    unarrived = jnp.sum(state.group_count * (state.group_arrival > t_end), axis=-1)
+    unarrived = jnp.sum(state.group_count * (state.group_arrival > t_end), axis=-1, dtype=I32)
     pub_height = state.height - state.n_private - unarrived
     arrived_mask = state.group_arrival <= t_end
     last_arrived = jnp.max(jnp.where(arrived_mask, state.group_arrival, NEG_TIME_CAP), axis=-1)
@@ -513,10 +524,10 @@ def final_stats(state: SimState, t_end: jax.Array) -> dict[str, jax.Array]:
     b32 = onehot_b.astype(I32)
 
     if state.cp is not None:
-        cp_b = jnp.sum(state.cp * b32[:, None, None], axis=0)  # [j, o] = cp[b, j, o]
-        own_in_b = jnp.sum(cp_b * b32[:, None], axis=0)  # [o] = cp[b, b, o]
+        cp_b = jnp.sum(state.cp * b32[:, None, None], axis=0, dtype=I32)  # [j, o] = cp[b, j, o]
+        own_in_b = jnp.sum(cp_b * b32[:, None], axis=0, dtype=I32)  # [o] = cp[b, b, o]
     else:
-        own_in_b = jnp.sum(state.own_in * b32[:, None], axis=0)
+        own_in_b = jnp.sum(state.own_in * b32[:, None], axis=0, dtype=I32)
     unpub_b = _at(state.height, onehot_b) - best_h
     found = own_in_b - unpub_b * b32
     denom = jnp.maximum(best_h, 1).astype(jnp.float32)

@@ -30,7 +30,21 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["XoroStreams", "seed_streams", "next_words", "next_uniform", "exporand"]
+__all__ = [
+    "XoroStreams",
+    "seed_streams",
+    "next_words",
+    "next_uniform",
+    "uniform_from_word",
+    "exporand",
+    "engine_run_seeds",
+    "select_streams",
+    "pack_run_streams",
+    "unpack_run_streams",
+    "interval_ms_from_word",
+    "winner_from_word64",
+    "thresholds64_limbs",
+]
 
 U32 = jnp.uint32
 _MASK32 = np.uint64(0xFFFFFFFF)
@@ -120,19 +134,26 @@ def next_words(state: XoroStreams) -> tuple[XoroStreams, jax.Array, jax.Array]:
     return XoroStreams(n0h, n0l, n1h, n1l), oh, ol
 
 
-def next_uniform(state: XoroStreams) -> tuple[XoroStreams, jax.Array]:
-    """Uniform in [0, 1) from the top bits of the next word.
+def uniform_from_word(hi: jax.Array, lo: jax.Array) -> jax.Array:
+    """Uniform in [0, 1) from one 64-bit generator word's uint32 limbs.
 
     The reference maps the top 53 bits onto a double (xoroshiro128++.h:17-20).
-    On CPU (float64 enabled) this reproduces that exactly; on TPU, where only
+    With float64 available this reproduces that exactly; on TPU, where only
     float32 exists, the top 24 bits are used — the generator stays bit-exact,
-    only the final float mapping is quantized.
+    only the final float mapping is quantized. (The int32 detour on the
+    float32 path exists because Mosaic has no uint32->float32 cast; after
+    >>8 the value fits in 24 bits, so it is exact.)
     """
-    state, hi, lo = next_words(state)
     if jax.dtypes.canonicalize_dtype(jnp.float64) == jnp.float64:
         u = (hi.astype(jnp.uint64) << jnp.uint64(32) | lo.astype(jnp.uint64)) >> jnp.uint64(11)
-        return state, u.astype(jnp.float64) * jnp.float64(2.0**-53)
-    return state, (hi >> U32(8)).astype(jnp.float32) * jnp.float32(2.0**-24)
+        return u.astype(jnp.float64) * jnp.float64(2.0**-53)
+    return (hi >> U32(8)).astype(jnp.int32).astype(jnp.float32) * jnp.float32(2.0**-24)
+
+
+def next_uniform(state: XoroStreams) -> tuple[XoroStreams, jax.Array]:
+    """Advance every stream one step and map the word to uniform [0, 1)."""
+    state, hi, lo = next_words(state)
+    return state, uniform_from_word(hi, lo)
 
 
 def exporand(state: XoroStreams, mean) -> tuple[XoroStreams, jax.Array]:
@@ -140,6 +161,102 @@ def exporand(state: XoroStreams, mean) -> tuple[XoroStreams, jax.Array]:
     construction ``-log1p(-u) * mean`` (xoroshiro128++.h:36-39)."""
     state, u = next_uniform(state)
     return state, -jnp.log1p(-u) * mean
+
+
+# --- engine integration (rng="xoroshiro") ----------------------------------
+# The engine replaces its counter-based threefry draws with two sequential
+# per-run streams matching the native backend's derivation
+# (native/simcore.cpp simulate_run): mix = splitmix64-advance(seed), then
+# interval_seed = mix ^ (C * (2*run+1)), winner_seed = mix ^ (C * (2*run+2)).
+
+_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+_STREAM_C = np.uint64(0x517CC1B727220A95)
+
+
+def engine_run_seeds(seed: int, start: int, count: int) -> tuple[np.ndarray, np.ndarray]:
+    """(interval_seeds, winner_seeds) for global run indices [start, start+count),
+    bit-matching the native backend's per-run stream derivation."""
+    with np.errstate(over="ignore"):
+        # Mask to the C++ uint64 conversion semantics so negative seeds (fine
+        # for the threefry path) work identically here.
+        mix = np.uint64(seed & 0xFFFFFFFFFFFFFFFF) + _GOLDEN  # (void)splitmix64(mix)
+        idx = np.arange(start, start + count, dtype=np.uint64)
+        return (
+            mix ^ (_STREAM_C * (np.uint64(2) * idx + np.uint64(1))),
+            mix ^ (_STREAM_C * (np.uint64(2) * idx + np.uint64(2))),
+        )
+
+
+def pack_run_streams(seed: int, start: int, count: int) -> np.ndarray:
+    """Seed both per-run streams and pack them as one (count, 8) uint32 array
+    — the engine's opaque per-run sampling-identity input ("keys") for
+    rng="xoroshiro". Layout: interval stream limbs [0:4], winner [4:8], each
+    as (s0_hi, s0_lo, s1_hi, s1_lo)."""
+    si, sw = engine_run_seeds(seed, start, count)
+    a, b = seed_streams(si), seed_streams(sw)
+    return np.stack(
+        [np.asarray(x, dtype=np.uint32) for x in (*a, *b)], axis=1
+    )
+
+
+def unpack_run_streams(packed: jax.Array) -> tuple[XoroStreams, XoroStreams]:
+    """Inverse of :func:`pack_run_streams` for one run (vmapped by the engine):
+    takes the (8,) uint32 row, returns (interval_stream, winner_stream)."""
+    return (
+        XoroStreams(packed[0], packed[1], packed[2], packed[3]),
+        XoroStreams(packed[4], packed[5], packed[6], packed[7]),
+    )
+
+
+def select_streams(pred: jax.Array, new: XoroStreams, old: XoroStreams) -> XoroStreams:
+    """Per-stream conditional advance: the sequential generator only moves
+    when its draw was actually consumed (unlike threefry, which burns one
+    counter per scan step unconditionally)."""
+    return XoroStreams(*(jnp.where(pred, n, o) for n, o in zip(new, old)))
+
+
+def thresholds64_limbs(thresholds_u64: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Split the reference's cumulative uint64 winner thresholds
+    (sampling.winner_thresholds) into uint32 (hi, lo) limb arrays for the
+    TPU-native 64-bit comparison in :func:`winner_from_word64`."""
+    return (
+        (thresholds_u64 >> np.uint64(32)).astype(np.uint32),
+        (thresholds_u64 & _MASK32).astype(np.uint32),
+    )
+
+
+def winner_from_word64(hi: jax.Array, lo: jax.Array, thr_hi: jax.Array,
+                       thr_lo: jax.Array) -> jax.Array:
+    """First miner whose cumulative uint64 threshold strictly exceeds the
+    64-bit draw (native simcore draw_winner; reference simulation.h:213-221),
+    clamped to the last miner for the ~16/2^64 overflow draws — as pure
+    uint32 limb compares, bit-exact on TPU."""
+    le = (thr_hi < hi) | ((thr_hi == hi) & (thr_lo <= lo))  # threshold <= draw
+    w = jnp.sum(le, dtype=jnp.int32)
+    return jnp.minimum(w, jnp.int32(thr_hi.shape[0] - 1))
+
+
+def interval_ms_from_word(hi: jax.Array, lo: jax.Array, mean_interval_ms,
+                          cap_ms: float) -> jax.Array:
+    """Block interval in integer ms (int32) from one 64-bit generator word,
+    following the native/reference construction: uniform from the top bits
+    (:func:`uniform_from_word`), exponential in NANOseconds, llround,
+    truncate to ms (native simcore draw_interval; reference
+    simulation.h:205-210).
+
+    With float64 available (CPU tests run the A/B harness under
+    JAX_ENABLE_X64) this is bit-exact vs the native backend. On TPU there is
+    no float64: the 24-bit float32 uniform perturbs a draw by ~6e-8 relative
+    — the generator words themselves stay bit-exact.
+    """
+    u = uniform_from_word(hi, lo)
+    if u.dtype == jnp.float64:
+        expo_ns = -jnp.log1p(-u) * jnp.float64(mean_interval_ms * 1e6)
+        ns = jnp.floor(expo_ns + 0.5)  # llround for positive values
+        ms = jnp.floor(ns / 1e6)
+        return jnp.minimum(ms, cap_ms).astype(jnp.int32)
+    expo_ms = -jnp.log1p(-u) * jnp.float32(mean_interval_ms)
+    return jnp.minimum(expo_ms, jnp.float32(cap_ms)).astype(jnp.int32)
 
 
 def reference_words(seed: int, n: int) -> np.ndarray:
