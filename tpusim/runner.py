@@ -130,6 +130,11 @@ def run_simulation_config(
     fp_dict = json.loads(config.to_json())
     fp_dict.pop("runs", None)
     fp_dict.pop("batch_size", None)
+    # The default generator is omitted so checkpoints from before the rng
+    # field existed (identical threefry draws) still resume; non-default
+    # generators fingerprint explicitly.
+    if fp_dict.get("rng") == "threefry":
+        fp_dict.pop("rng")
     # mode="auto"'s routing rules may change between versions (e.g. the
     # race-ratio threshold); fingerprint the *resolved* representation so a
     # resumed sweep can never silently merge fast-mode (lower-bound stale)
