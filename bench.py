@@ -151,11 +151,15 @@ def main() -> int:
         smoke_rate = None
         if not args.skip_smoke:
             phase = "smoke"
-            # 1024 runs on TPU: PallasEngine routes batches below tile_runs
-            # (1024) wholly to its scan twin, so a smaller smoke would measure
+            # PallasEngine routes batches below its fast-mode tile_runs
+            # wholly to its scan twin, so a smaller smoke would measure
             # — and "prove" — the wrong engine. CPU is far slower; keep its
             # smoke small (the scan engine is the only CPU engine anyway).
-            smoke_runs, smoke_days = (128, 14) if platform == "cpu" else (1024, 30)
+            from tpusim.pallas_engine import FAST_TILE_RUNS
+
+            smoke_runs, smoke_days = (
+                (128, 14) if platform == "cpu" else (2 * FAST_TILE_RUNS, 30)
+            )
             smoke_cfg = SimConfig(
                 network=default_network(propagation_ms=1000),
                 duration_ms=smoke_days * 86_400_000,
@@ -193,10 +197,13 @@ def main() -> int:
             if smoke_rate is not None:
                 # Keep the (untimed) full-batch warm-up under ~4 minutes even
                 # if the chip only ever reaches ~4x the smoke rate.
-                # Floor at 1024 = PallasEngine's tile_runs: any smaller batch
-                # routes wholly to the scan twin and would measure the wrong
-                # engine.
-                while batch > 1024 and batch * years_per_run / (4 * smoke_rate) > 240.0:
+                # Floor at PallasEngine's fast-mode tile_runs: any smaller
+                # batch routes wholly to the scan twin and would measure the
+                # wrong engine.
+                from tpusim.pallas_engine import FAST_TILE_RUNS
+
+                while batch > FAST_TILE_RUNS and \
+                        batch * years_per_run / (4 * smoke_rate) > 240.0:
                     batch //= 2
         info["batch_size"] = batch
 

@@ -123,7 +123,15 @@ class SimConfig:
     runs: int = DEFAULT_RUNS
     seed: int = 0
     batch_size: int = 4096
-    group_slots: int = 4
+    #: In-flight arrival-group buffer slots per (run, miner); None = auto.
+    #: Auto resolves to 2 in fast mode (its accuracy domain caps the race
+    #: ratio at ~1e-2, where a third concurrent own-group needs two own
+    #: finds inside one propagation window, ~(share*ratio)^2 per block —
+    #: measured 31 counted overflows in 4.3e8 blocks at the reference
+    #: default, and K-sized ops are ~20% of step time) and 4 in exact mode
+    #: (selfish reveals push multi-group bursts). Overflow merges the two
+    #: newest groups, counted in the reported ``overflow_sum`` diagnostic.
+    group_slots: int | None = None
     mode: str = "auto"
     chunk_steps: int | None = None
     #: Sampling generator. ``"threefry"`` (default): counter-based JAX draws,
@@ -145,8 +153,8 @@ class SimConfig:
             raise ValueError(f"mode must be auto|exact|fast, got {self.mode!r}")
         if self.rng not in ("threefry", "xoroshiro"):
             raise ValueError(f"rng must be threefry|xoroshiro, got {self.rng!r}")
-        if self.group_slots < 2:
-            raise ValueError("group_slots must be >= 2")
+        if self.group_slots is not None and self.group_slots < 2:
+            raise ValueError("group_slots must be >= 2 (or None for auto)")
         if self.chunk_steps is not None and self.chunk_steps < 1:
             raise ValueError("chunk_steps must be >= 1 (or None for auto)")
         # 32-bit time-arithmetic envelope (see tpusim.state docstring): one
@@ -163,6 +171,12 @@ class SimConfig:
         probability scale that bounds fast mode's stale-count shortfall."""
         max_prop_ms = max(m.propagation_ms for m in self.network.miners)
         return max_prop_ms / (self.network.block_interval_s * 1000.0)
+
+    @property
+    def resolved_group_slots(self) -> int:
+        if self.group_slots is not None:
+            return self.group_slots
+        return 4 if self.resolved_mode == "exact" else 2
 
     @property
     def resolved_mode(self) -> str:
@@ -216,9 +230,11 @@ def _config_from_dict(d: dict[str, Any]) -> SimConfig:
     )
     network = NetworkConfig(miners=miners, block_interval_s=float(net.get("block_interval_s", BLOCK_INTERVAL_S)))
     kwargs: dict[str, Any] = {}
-    for key in ("duration_ms", "runs", "seed", "batch_size", "group_slots"):
+    for key in ("duration_ms", "runs", "seed", "batch_size"):
         if key in d:
             kwargs[key] = int(d[key])
+    if d.get("group_slots") is not None:
+        kwargs["group_slots"] = int(d["group_slots"])
     if d.get("chunk_steps") is not None:
         kwargs["chunk_steps"] = int(d["chunk_steps"])
     if "mode" in d:

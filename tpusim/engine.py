@@ -206,7 +206,9 @@ class Engine:
             + 4
         )
 
-        m, k, exact, steps = self.n_miners, config.group_slots, self.exact, self.chunk_steps
+        m, k, exact, steps = (
+            self.n_miners, config.resolved_group_slots, self.exact, self.chunk_steps
+        )
         any_selfish = self.any_selfish
 
         xoro = config.rng == "xoroshiro"

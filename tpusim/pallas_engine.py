@@ -51,7 +51,12 @@ from .state import (
     rebase,
 )
 
-__all__ = ["PallasEngine"]
+__all__ = ["PallasEngine", "FAST_TILE_RUNS", "EXACT_TILE_RUNS"]
+
+#: Default run-tile widths (VPU lanes per grid cell), set from v5e
+#: measurements — see PallasEngine.__init__ for the rationale.
+FAST_TILE_RUNS = 512
+EXACT_TILE_RUNS = 256
 
 logger = logging.getLogger("tpusim")
 
@@ -340,11 +345,14 @@ class PallasEngine(Engine):
                 "rng='xoroshiro' runs on the scan engine"
             )
         if tile_runs is None:
-            # Measured on v5e (16 MiB scoped VMEM): fast mode fits 1024 lanes
-            # comfortably and 1024 beats 512 by ~1.6x; exact mode's
-            # (M, M, M, tile) cp tensor and its contraction temporaries blow
-            # the scoped-VMEM limit at 512 (17.4 MiB) and lower at 256.
-            tile_runs = 256 if config.resolved_mode == "exact" else 1024
+            # Measured on v5e (16 MiB scoped VMEM), 8192 runs x 365 d: fast
+            # mode peaks at 512 lanes (1877 yr/s vs 1749 at 1024 with K=2);
+            # exact mode's (M, M, M, tile) cp tensor and its contraction
+            # temporaries blow the scoped-VMEM limit at 512 (17.4 MiB) and
+            # lower at 256.
+            tile_runs = (
+                EXACT_TILE_RUNS if config.resolved_mode == "exact" else FAST_TILE_RUNS
+            )
         if tile_runs % 128 != 0:
             raise ValueError("tile_runs must be a multiple of 128")
         super().__init__(config, None)
@@ -447,7 +455,7 @@ class PallasEngine(Engine):
 
     def _pallas_chunk(self, state: SimState, aux, cap, keys, chunk_idx, params):
         n = cap.shape[0]
-        m, k = self.n_miners, self.config.group_slots
+        m, k = self.n_miners, self.config.resolved_group_slots
         steps, sb, tile = self.chunk_steps, self.step_block, self.tile_runs
         if n % tile != 0:
             raise ValueError(f"batch ({n}) must be a multiple of tile_runs ({tile})")

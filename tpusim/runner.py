@@ -140,6 +140,9 @@ def run_simulation_config(
     # resumed sweep can never silently merge fast-mode (lower-bound stale)
     # sums with exact-mode ones.
     fp_dict["mode"] = config.resolved_mode
+    # Like mode: group_slots=None resolves by mode and the resolved buffer
+    # size affects overflow behavior, so it is part of the identity.
+    fp_dict["group_slots"] = config.resolved_group_slots
     # chunk_steps=None resolves to an engine-chosen default that may change
     # between versions; fingerprint the *resolved* value, which is what fixes
     # the step->key sampling identity.

@@ -44,7 +44,7 @@ def drive_state_events(
     deferred notify on same-ms finds, cut-through)."""
     params = make_params(config)
     exact = config.resolved_mode == "exact"
-    state = init_state(config.network.n_miners, config.group_slots, exact)
+    state = init_state(config.network.n_miners, config.resolved_group_slots, exact)
     state = state._replace(next_block_time=jnp.asarray(int(intervals[0]), TIME))
     i_interval, i_winner = 1, 0
     duration = config.duration_ms
@@ -98,7 +98,7 @@ def state_from_chains(
     private blocks. Raises if a chain violates the invariants the automaton
     relies on (trailing-only private/unarrived blocks, sorted arrivals)."""
     m = len(chains)
-    k = config.group_slots
+    k = config.resolved_group_slots
     exact = config.resolved_mode == "exact"
     height = np.array([len(c) for c in chains], dtype=np.int32)
     n_private = np.zeros(m, np.int32)
