@@ -134,7 +134,7 @@ def main() -> int:
 
         from tpusim import SimConfig, default_network, DEFAULT_DURATION_MS
         from tpusim.engine import Engine
-        from tpusim.pallas_engine import PallasEngine
+        from tpusim.pallas_engine import FAST_TILE_RUNS, PallasEngine
         from tpusim.runner import make_engine, make_run_keys
 
         def build_engine(config: SimConfig):
@@ -155,8 +155,6 @@ def main() -> int:
             # wholly to its scan twin, so a smaller smoke would measure
             # — and "prove" — the wrong engine. CPU is far slower; keep its
             # smoke small (the scan engine is the only CPU engine anyway).
-            from tpusim.pallas_engine import FAST_TILE_RUNS
-
             smoke_runs, smoke_days = (
                 (128, 14) if platform == "cpu" else (2 * FAST_TILE_RUNS, 30)
             )
@@ -200,8 +198,6 @@ def main() -> int:
                 # Floor at PallasEngine's fast-mode tile_runs: any smaller
                 # batch routes wholly to the scan twin and would measure the
                 # wrong engine.
-                from tpusim.pallas_engine import FAST_TILE_RUNS
-
                 while batch > FAST_TILE_RUNS and \
                         batch * years_per_run / (4 * smoke_rate) > 240.0:
                     batch //= 2

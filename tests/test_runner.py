@@ -204,6 +204,22 @@ def test_interval_from_bits_zero_and_positive():
     assert int(interval_from_bits(jnp.uint32(2**32 - 1), 600_000.0)) > 0
 
 
+def test_group_slots_auto_resolution_and_roundtrip():
+    """group_slots=None resolves 2 in fast mode / 4 in exact, survives JSON
+    round-trip as None, and an explicit value is respected everywhere."""
+    fast = SimConfig(network=default_network(propagation_ms=1000))
+    assert fast.resolved_mode == "fast" and fast.resolved_group_slots == 2
+    exact = dataclasses.replace(fast, mode="exact")
+    assert exact.resolved_group_slots == 4
+    assert SimConfig.from_json(fast.to_json()).group_slots is None
+    explicit = dataclasses.replace(fast, group_slots=8)
+    assert explicit.resolved_group_slots == 8
+    assert SimConfig.from_json(explicit.to_json()).resolved_group_slots == 8
+    assert Engine(explicit).params is not None  # builds with explicit K
+    with pytest.raises(ValueError, match="group_slots"):
+        dataclasses.replace(fast, group_slots=1)
+
+
 def test_config_validation_errors():
     with pytest.raises(ValueError, match="sum to 100"):
         NetworkConfig(miners=(MinerConfig(hashrate_pct=50),))

@@ -42,7 +42,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--selfish", type=str, default="", help="comma-separated selfish miner indices")
     p.add_argument("--block-interval-s", type=float, default=600.0)
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--batch-size", type=int, default=4096)
+    p.add_argument(
+        "--batch-size", type=int, default=None,
+        help="runs per device batch (default: SimConfig's tuned default)",
+    )
     p.add_argument("--mode", choices=("auto", "exact", "fast"), default="auto")
     p.add_argument(
         "--rng",
@@ -85,14 +88,17 @@ def config_from_args(args: argparse.Namespace) -> SimConfig:
         for i, (h, pr) in enumerate(zip(hashrates, props))
     )
     duration_ms = int(args.days * 86_400_000) if args.days else args.duration_ms
+    kwargs = {}
+    if args.batch_size is not None:
+        kwargs["batch_size"] = args.batch_size
     return SimConfig(
         network=NetworkConfig(miners=miners, block_interval_s=args.block_interval_s),
         duration_ms=duration_ms,
         runs=args.runs,
         seed=args.seed,
-        batch_size=args.batch_size,
         mode=args.mode,
         rng=args.rng,
+        **kwargs,
     )
 
 
