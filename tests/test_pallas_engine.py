@@ -85,6 +85,20 @@ def test_pallas_refuses_fast_selfish_and_mesh():
         PallasEngine(honest, mesh=object())
 
 
+def test_pallas_refuses_oversized_vmem_config():
+    """A 32-miner exact config's cp block cannot fit scoped VMEM at any tile;
+    the guard must reject it in __init__ (before Mosaic can hang on it) so
+    make_engine falls back to the scan engine — except under interpret=True,
+    the no-VMEM-limit debug path."""
+    from tpusim.sweep import _hetero32_network
+
+    big = SimConfig(network=_hetero32_network(), runs=128, duration_ms=600_000)
+    assert big.resolved_mode == "exact"
+    with pytest.raises(ValueError, match="VMEM"):
+        PallasEngine(big, tile_runs=128)
+    PallasEngine(big, tile_runs=128, interpret=True)  # debug path still builds
+
+
 def test_scan_twin_shares_resolved_chunk_steps_with_auto_sizing():
     """With chunk_steps=None and a short duration, the auto path 64-aligns the
     resolved value possibly above the raw event bound; the scan twin pins that

@@ -33,6 +33,7 @@ simulation.h:62-174 model, via SURVEY.md §2.1.
 from __future__ import annotations
 
 import logging
+import math
 
 import numpy as np
 import jax
@@ -355,6 +356,26 @@ class PallasEngine(Engine):
             )
         if tile_runs % 128 != 0:
             raise ValueError("tile_runs must be a multiple of 128")
+        # Refuse configs whose per-tile state cannot fit scoped VMEM *before*
+        # handing the kernel to Mosaic: an oversized kernel (e.g. 32 miners in
+        # exact mode — the cp block alone is m^3*tile*4 = 33 MB at tile 256)
+        # can grind the remote compiler for tens of minutes instead of
+        # failing, and make_engine's scan fallback never gets a chance. The
+        # factor 10 is anchored on the measured 9-miner exact footprint
+        # (17.4 MiB at tile 512 = state-bytes x tile x ~10 for the
+        # contraction temporaries). The interpreter has no such limit, so
+        # interpret=True skips the guard (it is the debug path for exactly
+        # these configs).
+        m, k = config.network.n_miners, config.resolved_group_slots
+        exact = config.resolved_mode == "exact"
+        state_words = sum(math.prod(s) for s in _leaf_shapes(m, k, exact))
+        vmem_est = state_words * 4 * tile_runs * 10
+        if vmem_est > 15_500_000 and not interpret:
+            raise ValueError(
+                f"estimated kernel VMEM footprint {vmem_est / 1e6:.1f} MB exceeds "
+                f"the 16 MB scoped limit ({m} miners, {'exact' if exact else 'fast'} "
+                f"mode, tile_runs={tile_runs}); use the scan engine"
+            )
         super().__init__(config, None)
         # The kernel consumes whole step blocks. The scan engine's auto
         # sizing is 64-aligned on every platform; silently changing an
