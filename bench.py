@@ -26,7 +26,6 @@ import argparse
 import json
 import os
 import signal
-import subprocess
 import sys
 import time
 
@@ -42,31 +41,6 @@ def emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
 
 
-def probe_backend(timeout_s: float) -> str | None:
-    """Ask a subprocess which platform jax sees; None on hang/failure.
-
-    The round-3 failure mode was an in-process PJRT init hang/UNAVAILABLE
-    (BENCH_r03.json); a subprocess probe can be killed on timeout, and a
-    successful probe warms the tunnel for the in-process init that follows.
-    """
-    code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True, text=True, timeout=timeout_s,
-            env=os.environ.copy(),
-        )
-    except subprocess.TimeoutExpired:
-        log(f"backend probe timed out after {timeout_s:.0f}s")
-        return None
-    if r.returncode != 0:
-        tail = (r.stderr or "").strip().splitlines()[-1:] or ["?"]
-        log(f"backend probe failed rc={r.returncode}: {tail[0][:200]}")
-        return None
-    for line in r.stdout.splitlines():
-        if line.startswith("PLATFORM="):
-            return line.split("=", 1)[1]
-    return None
 
 
 class _Watchdog(Exception):
@@ -108,20 +82,17 @@ def main() -> int:
     signal.alarm(int(args.hard_timeout))
 
     try:
-        # --- Phase: backend init with subprocess probes + CPU fallback.
-        platform = None
-        for attempt in range(args.probe_retries):
-            t0 = time.monotonic()
-            platform = probe_backend(args.probe_timeout)
-            if platform is not None:
-                log(f"backend probe ok: {platform} ({time.monotonic() - t0:.1f}s)")
-                break
-            if attempt + 1 < args.probe_retries:
-                backoff = 10.0 * (attempt + 1)
-                log(f"retrying backend probe in {backoff:.0f}s "
-                    f"({attempt + 1}/{args.probe_retries})")
-                time.sleep(backoff)
-        if platform is None:
+        # --- Phase: backend init with subprocess probes + CPU fallback
+        # (tpusim.probe: the tunneled backend can hang jax.devices() in-process).
+        from tpusim.probe import probe_backend
+
+        t0 = time.monotonic()
+        platform = probe_backend(
+            timeout_s=args.probe_timeout, retries=args.probe_retries, log=log
+        )
+        if platform is not None:
+            log(f"backend probe ok: {platform} ({time.monotonic() - t0:.1f}s)")
+        else:
             log("accelerator backend unavailable after retries; falling back to CPU")
             os.environ["JAX_PLATFORMS"] = "cpu"
             info["tpu_unavailable"] = True

@@ -1,0 +1,37 @@
+"""tpusim.probe: the killable subprocess backend probe."""
+
+from __future__ import annotations
+
+import sys
+
+from tpusim.probe import probe_backend
+
+
+def test_probe_reports_cpu_platform(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    msgs = []
+    assert probe_backend(timeout_s=120, retries=1, log=msgs.append) == "cpu"
+    assert not msgs
+
+
+def test_probe_failure_returns_none_with_log(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "definitely-not-a-platform")
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    msgs = []
+    assert probe_backend(timeout_s=120, retries=1, log=msgs.append) is None
+    assert msgs and "probe failed" in msgs[0]
+
+
+def test_probe_timeout_path(monkeypatch):
+    # A probe that cannot finish in time must be killed and reported, not
+    # hang the caller — simulate with an interpreter that sleeps in
+    # sitecustomize-equivalent position via PYTHONSTARTUP-independent trick:
+    # point PYTHONPATH at nothing and give the real probe far too little
+    # time to even start the interpreter+jax import.
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    msgs = []
+    assert probe_backend(timeout_s=0.01, retries=1, log=msgs.append) is None
+    assert msgs and "timed out" in msgs[0]
+    assert sys.executable  # smoke: the probe used this interpreter

@@ -1,0 +1,54 @@
+"""Subprocess-based accelerator backend probe.
+
+The tunneled TPU PJRT backend in this environment can wedge: ``jax.devices()``
+then hangs for minutes inside the caller's own process, where no timeout can
+rescue it (observed in rounds 3 and 4 — the BENCH_r03 failure and two lost
+sweep launches). Probing from a *subprocess* is killable on timeout, and a
+successful probe both proves and warms the tunnel for the in-process backend
+init that follows.
+
+Used by bench.py and the sweep CLI; safe to call before jax is imported in
+the calling process (that is the point).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Callable
+
+
+def probe_backend(
+    timeout_s: float = 150.0,
+    retries: int = 3,
+    backoff_s: float = 10.0,
+    log: Callable[[str], None] | None = None,
+) -> str | None:
+    """Return the platform name jax sees ("tpu", "cpu", ...) or None if the
+    backend never comes up within ``retries`` subprocess probes."""
+    say = log or (lambda msg: print(f"[probe] {msg}", file=sys.stderr, flush=True))
+    code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
+    for attempt in range(retries):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=timeout_s,
+                env=os.environ.copy(),
+            )
+        except subprocess.TimeoutExpired:
+            say(f"backend probe timed out after {timeout_s:.0f}s")
+            r = None
+        if r is not None:
+            if r.returncode == 0:
+                for line in r.stdout.splitlines():
+                    if line.startswith("PLATFORM="):
+                        return line.split("=", 1)[1]
+            tail = (r.stderr or "").strip().splitlines()[-1:] or ["?"]
+            say(f"backend probe failed rc={r.returncode}: {tail[0][:200]}")
+        if attempt + 1 < retries:
+            pause = backoff_s * (attempt + 1)
+            say(f"retrying backend probe in {pause:.0f}s ({attempt + 1}/{retries})")
+            time.sleep(pause)
+    return None

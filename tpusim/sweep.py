@@ -179,6 +179,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--out", type=Path, help="append one JSON line per point here")
     p.add_argument("--checkpoint-dir", type=Path, help="per-point npz checkpoints (tpu backend)")
     p.add_argument("--quiet", action="store_true")
+    p.add_argument(
+        "--no-probe", action="store_true",
+        help="skip the pre-flight accelerator probe (tpu backend only)",
+    )
     args = p.parse_args(argv)
 
     if args.list or not args.sweep:
@@ -189,6 +193,30 @@ def main(argv: list[str] | None = None) -> int:
             for pname, c in points:
                 print(f"  - {pname}: {c.network.n_miners} miners, {c.runs} runs")
         return 0
+
+    if args.backend == "tpu" and not args.no_probe:
+        # The tunneled TPU backend can wedge jax.devices() inside this
+        # process where nothing can time it out; prove the backend from a
+        # killable subprocess first and fail loudly instead of hanging a
+        # multi-hour sweep at init (tpusim.probe).
+        from .probe import probe_backend
+
+        platform = probe_backend()
+        if platform is None:
+            print(
+                "error: accelerator backend unavailable after probe retries; "
+                "re-run later, with --backend cpp, or with --no-probe",
+                file=sys.stderr,
+            )
+            return 2
+        if platform != "tpu":
+            # The JAX engine runs anywhere; a CPU-only environment is a
+            # legitimate (if slow) place to smoke a sweep — say so loudly.
+            print(
+                f"warning: no TPU visible (platform={platform}); the sweep "
+                f"will run on {platform}",
+                file=sys.stderr,
+            )
 
     run_sweep(
         sweeps[args.sweep](),
