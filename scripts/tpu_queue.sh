@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Wait for the tunneled TPU to come back (killable subprocess probes every
+# 5 min, tpusim.probe), then run the queued TPU jobs sequentially. Used when
+# the tunnel wedges mid-session; safe to re-run — sweep points resume from
+# their per-point checkpoints.
+set -u
+cd "$(dirname "$0")/.."
+
+echo "[queue] waiting for TPU backend..."
+until python - <<'EOF'
+import sys
+from tpusim.probe import probe_backend
+sys.exit(0 if probe_backend(timeout_s=120, retries=1) == "tpu" else 1)
+EOF
+do
+  echo "[queue] TPU still unavailable; retrying in 300s"
+  sleep 300
+done
+echo "[queue] TPU is back; running queued jobs"
+
+python -m tpusim.sweep hetero32 --runs-scale 0.00390625 \
+  --out artifacts/sweep_hetero32_scale0.0039.jsonl \
+  --checkpoint-dir artifacts/ck_h32b --quiet
+python -m tpusim.sweep selfish-threshold --runs-scale 0.0002 \
+  --out artifacts/sweep_selfish_threshold_scale2e-4.jsonl \
+  --checkpoint-dir artifacts/ck_thr --quiet
+python bench.py --target-seconds 30 > /tmp/bench_requeue.json 2>/tmp/bench_requeue.log
+echo "[queue] done"

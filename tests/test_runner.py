@@ -70,6 +70,24 @@ def test_device_loop_matches_host_loop():
         # (lo < 0 & hi > 0), and the hi*base+lo t_end reconstruction of the
         # device ledger are all live — not just the single-limb fast path.
         dataclasses.replace(SMALL, runs=8, batch_size=8, duration_ms=14 * 86_400_000),
+        # 26 days > 2^31 ms: the duration no longer fits int32 at all and
+        # hi0 = 2, so the ledger borrows more than once per run (~4 TIME_CAP
+        # window crossings each).
+        dataclasses.replace(
+            SMALL,
+            runs=4,
+            batch_size=4,
+            duration_ms=26 * 86_400_000,
+            network=NetworkConfig(
+                miners=(
+                    MinerConfig(hashrate_pct=60, propagation_ms=2000),
+                    MinerConfig(hashrate_pct=40, propagation_ms=500),
+                ),
+                # 30 min interval: ~1250 blocks (~2550 events) over 26 d keeps
+                # every window busy while the whole case stays under a minute.
+                block_interval_s=3600.0 / 2,
+            ),
+        ),
     ):
         engine = Engine(config)
         keys = make_run_keys(config.seed, 0, config.runs)
