@@ -2,27 +2,40 @@
 # Wait for the tunneled TPU to come back (killable subprocess probes every
 # 5 min, tpusim.probe), then run the queued TPU jobs sequentially. Used when
 # the tunnel wedges mid-session; safe to re-run — sweep points resume from
-# their per-point checkpoints.
+# their per-point checkpoints. Re-probes before every job (the tunnel can
+# wedge again between jobs — launching in-process against a dead backend is
+# the unkillable hang tpusim/probe.py documents), stops the queue on the
+# first failed job, and exits nonzero so wrappers chaining on it see it.
 set -u
 cd "$(dirname "$0")/.."
 
-echo "[queue] waiting for TPU backend..."
-until python - <<'EOF'
+wait_for_tpu() {
+  until python - <<'EOF'
 import sys
 from tpusim.probe import probe_backend
 sys.exit(0 if probe_backend(timeout_s=120, retries=1) == "tpu" else 1)
 EOF
-do
-  echo "[queue] TPU still unavailable; retrying in 300s"
-  sleep 300
-done
-echo "[queue] TPU is back; running queued jobs"
+  do
+    echo "[queue] TPU unavailable; retrying in 300s"
+    sleep 300
+  done
+}
 
-python -m tpusim.sweep hetero32 --runs-scale 0.00390625 \
+run_job() {
+  echo "[queue] waiting for TPU backend..."
+  wait_for_tpu
+  echo "[queue] running: $*"
+  if ! "$@"; then
+    echo "[queue] FAILED (rc=$?): $*" >&2
+    exit 1
+  fi
+}
+
+run_job python -m tpusim.sweep hetero32 --runs-scale 0.00390625 \
   --out artifacts/sweep_hetero32_scale0.0039.jsonl \
   --checkpoint-dir artifacts/ck_h32b --quiet
-python -m tpusim.sweep selfish-threshold --runs-scale 0.0002 \
+run_job python -m tpusim.sweep selfish-threshold --runs-scale 0.0002 \
   --out artifacts/sweep_selfish_threshold_scale2e-4.jsonl \
   --checkpoint-dir artifacts/ck_thr --quiet
-python bench.py --target-seconds 30 > /tmp/bench_requeue.json 2>/tmp/bench_requeue.log
+run_job bash -c 'python bench.py --target-seconds 30 > /tmp/bench_requeue.json 2>/tmp/bench_requeue.log'
 echo "[queue] done"

@@ -20,6 +20,9 @@ def main() -> None:
     ap.add_argument("--selfish", action="store_true")
     ap.add_argument("--tile-runs", type=int, default=512)
     ap.add_argument("--step-block", type=int, default=64)
+    ap.add_argument("--chunk-steps", type=int, default=None,
+                    help="explicit chunk_steps (must be a multiple of step-block; "
+                         "the auto value is 64-aligned only)")
     ap.add_argument("--skip-scan", action="store_true")
     args = ap.parse_args()
 
@@ -39,7 +42,8 @@ def main() -> None:
     else:
         net = default_network(propagation_ms=1000)
     cfg = SimConfig(network=net, duration_ms=args.days * 86_400_000,
-                    runs=args.runs, batch_size=args.runs, seed=7)
+                    runs=args.runs, batch_size=args.runs, seed=7,
+                    chunk_steps=args.chunk_steps)
     eng = PallasEngine(cfg, tile_runs=args.tile_runs, step_block=args.step_block)
     years = args.runs * args.days / 365.2425
 

@@ -22,7 +22,7 @@ every byte. This module re-expresses the same step as a Pallas kernel over a
     tests/test_pallas_engine.py.
 
 Both consensus representations of tpusim.state are implemented: the pairwise
-fast mode (own_above / own_in) for honest rosters and the exact mode
+fast mode (own_cp / own_in / own_cnt) for honest rosters and the exact mode
 (common-prefix owner-count tensor ``cp``, private counters, the gamma=0
 reveal/race machinery) for selfish ones. The only unsupported combination is
 ``mode="fast"`` forced onto a selfish roster, which stays on the scan engine.
@@ -66,7 +66,9 @@ U32 = jnp.uint32
 
 #: State leaf order in the kernel's ref lists, per mode. ``shape`` templates
 #: use M (miners), K (group slots); the trailing runs axis is implicit.
-_FAST_LEAVES = ("t", "nbt", "height", "stale", "base", "garr", "gcnt", "oa", "oin", "ovf")
+_FAST_LEAVES = (
+    "t", "nbt", "height", "stale", "base", "garr", "gcnt", "ocp", "oin", "ocnt", "ovf",
+)
 _EXACT_LEAVES = (
     "t", "nbt", "bhp", "height", "npriv", "stale", "base", "garr", "gcnt", "cp", "ovf",
 )
@@ -77,7 +79,7 @@ def _leaf_shapes(m: int, k: int, exact: bool) -> list[tuple[int, ...]]:
         return [
             (1,), (1,), (1,), (m,), (m,), (m,), (m,), (m, k), (m, k), (m, m, m), (1,),
         ]
-    return [(1,), (1,), (m,), (m,), (m,), (m, k), (m, k), (m, m), (m, m), (1,)]
+    return [(1,), (1,), (m,), (m,), (m,), (m, k), (m, k), (m, m), (m, m), (m,), (1,)]
 
 
 def _make_kernel(
@@ -189,9 +191,10 @@ def _make_kernel(
             else:
                 push_do = ow
                 push_count = I32(1)
-                oa, oin = st["oa"], st["oin"]
-                oa = oa + (ow[:, None, :] & ~ow[None, :, :]).astype(I32)
-                oin = oin + (ow[:, None, :] & ow[None, :, :]).astype(I32)
+                # Fast mode: a find moves only the (M, R) own-count vector
+                # (tpusim.state.found_block) — no M x M traffic in the hot
+                # find path.
+                ocnt = st["ocnt"] + owi
 
             arrival = t + prop  # (M, R)
             garr, gcnt, over = push_groups(garr, gcnt, arrival, push_count, push_do)
@@ -274,17 +277,27 @@ def _make_kernel(
                 npriv = jnp.where(adopt, 0, npriv)
                 bhp = jnp.where(do, best_h, bhp)
             else:
-                oab = jnp.sum(oa * b32[None, :, :], axis=1)  # (M, R) own_above[:, b]
+                # tpusim.state.notify's fast branch: own_cp/own_in columns
+                # and rows for b, stored diagonals corrected from ocnt.
+                ocp, oin = st["ocp"], st["oin"]
+                cnt_b = jnp.sum(ocnt * b32, axis=0, keepdims=True)  # (1, R)
+                oc_b = jnp.sum(ocp * b32[None, :, :], axis=1)  # (M, R) own_cp[:, b]
+                oc_bb = jnp.sum(oc_b * b32, axis=0, keepdims=True)
+                oc_b = oc_b + b32 * (cnt_b - oc_bb)
+                oab = ocnt - oc_b  # (M, R) own blocks above lca(:, b)
                 stale = stale + jnp.where(adopt, oab, 0)
-                # b's row toward adopters gains its unpublished suffix (it
-                # sits above the adopted published prefix) — see
-                # tpusim.state.notify's fast branch.
-                col_val = oab + unpub_b * b32
-                oa = jnp.where(adopt[None, :, :], col_val[:, None, :], oa)
-                oa = jnp.where(adopt[:, None, :], 0, oa)
-                oin_b = jnp.sum(oin * b32[:, None, :], axis=0)  # (M, R) own_in[b, :]
-                oin_bpub = oin_b - unpub_b * b32
-                oin = jnp.where(adopt[:, None, :], oin_bpub[None, :, :], oin)
+                row_b = jnp.sum(oin * b32[:, None, :], axis=0)  # (M, R) own_in[b, :]
+                row_bb = jnp.sum(row_b * b32, axis=0, keepdims=True)
+                row_b = row_b + b32 * (cnt_b - row_bb)
+                row_bpub = row_b - unpub_b * b32
+                col_cp = oc_b - unpub_b * b32
+                ocp = jnp.where(
+                    adopt[:, None, :],
+                    row_bpub[:, None, :],
+                    jnp.where(adopt[None, :, :], col_cp[:, None, :], ocp),
+                )
+                oin = jnp.where(adopt[:, None, :], row_bpub[None, :, :], oin)
+                ocnt = jnp.where(adopt, row_bpub, ocnt)
 
             height = jnp.where(adopt, best_h, height)
             garr = jnp.where(adopt[:, None, :], inf, garr)
@@ -301,7 +314,7 @@ def _make_kernel(
             if exact:
                 st.update(npriv=npriv, bhp=bhp, cp=cp)
             else:
-                st.update(oa=oa, oin=oin)
+                st.update(ocp=ocp, oin=oin, ocnt=ocnt)
             return tuple(st[name] for name in names)
 
         carry = tuple(ref[...] for ref in outs)
@@ -453,7 +466,8 @@ class PallasEngine(Engine):
             state.t[None, :], state.next_block_time[None, :],
             tr(state.height), tr(state.stale), tr(state.base_tip_arrival),
             tr(state.group_arrival), tr(state.group_count),
-            tr(state.own_above), tr(state.own_in), state.overflow[None, :],
+            tr(state.own_cp), tr(state.own_in), tr(state.own_cnt),
+            state.overflow[None, :],
         )
 
     def _state_from_kernel(self, state: SimState, out) -> SimState:
@@ -466,12 +480,12 @@ class PallasEngine(Engine):
                 base_tip_arrival=bk(base), group_arrival=bk(garr),
                 group_count=bk(gcnt), cp=bk(cp), overflow=ovf[0],
             )
-        t, nbt, height, stale, base, garr, gcnt, oa, oin, ovf = out
+        t, nbt, height, stale, base, garr, gcnt, ocp, oin, ocnt, ovf = out
         return state._replace(
             t=t[0], next_block_time=nbt[0],
             height=bk(height), stale=bk(stale), base_tip_arrival=bk(base),
             group_arrival=bk(garr), group_count=bk(gcnt),
-            own_above=bk(oa), own_in=bk(oin), overflow=ovf[0],
+            own_cp=bk(ocp), own_in=bk(oin), own_cnt=bk(ocnt), overflow=ovf[0],
         )
 
     def _pallas_chunk(self, state: SimState, aux, cap, keys, chunk_idx, params):

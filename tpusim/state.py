@@ -35,17 +35,36 @@ is collapsed into fixed-shape integers per (run, miner):
                             tests/test_state_equivalence.py which checks it
                             against a literal chain simulator on random runs.
 
-A cheaper pairwise variant (``own_above[i,j]``, ``own_in[i,j]``, "fast" mode)
-drops the 3-index tensor. Its accuracy contract, for honest rosters
-(property-tested on adversarial streams in tests/test_property_equivalence.py):
+A cheaper pairwise variant ("fast" mode) drops the 3-index tensor. It keeps
+``own_cnt[i]`` (own blocks in own chain), ``own_cp[i,j]`` (own blocks in the
+common prefix of ``i``'s and ``j``'s chains — the ``cp[i,j,i]`` slice of the
+exact tensor) and ``own_in[j,o]`` (owner ``o``'s blocks in ``j``'s chain);
+the derived quantity ``own_above[i,j] = own_cnt[i] - own_cp[i,j]`` (own
+blocks above the lca) drives stale accounting. With this split a block find
+touches ONLY the length-M ``own_cnt`` (a new own block is above every lca
+and inside no common prefix), so no M x M array is written outside the
+adoption sweeps — roughly half the M^2-sized work per event versus
+maintaining ``own_above`` directly. (Measured on v5e the step is
+latency-bound, not element-bound, so this is throughput-neutral there; the
+representation is kept because it is the exact tensor's ``cp[i,j,i]`` slice
+— one semantics for both modes — and the reduced per-event footprint is
+what a wider-vector or multi-core backend would want.) The diagonals of
+``own_cp`` / ``own_in`` are NOT maintained by finds (``own_cnt`` is the
+authority for both); every read corrects the ``i == b`` entry
+arithmetically and adoption rewrites make the stored diagonal consistent
+again.
 
-  * every consensus observable is EXACT: ``own_in`` (each chain's per-owner
-    block counts, hence blocks_found / blocks_share / best_height) is
-    maintained exactly — its updates (+1 on own find; copy of the winner's
-    row minus its in-flight suffix on adopt) never consult ``own_above``;
+Accuracy contract of fast mode, for honest rosters (property-tested on
+adversarial streams in tests/test_property_equivalence.py):
+
+  * every consensus observable is EXACT: ``own_in``/``own_cnt`` (each
+    chain's per-owner block counts, hence blocks_found / blocks_share /
+    best_height) are maintained exactly — their updates (+1 on own find;
+    copy of the winner's row minus its in-flight suffix on adopt) never
+    consult ``own_cp``;
   * the ``stale`` counter is an elementwise LOWER BOUND of the true count.
-    Every ``own_above`` update is an exact nonneg increment, a copy of
-    another entry, or a zeroing of the adopter's row — so by induction
+    Every implied ``own_above`` update is an exact nonneg increment, a copy
+    of another entry, or a zeroing of the adopter's row — so by induction
     ``own_above <= truth`` elementwise, and stale increments never
     overcount. The shortfall is realized only when an adopter's adopted
     chain contains its own blocks above that chain's fork point with a
@@ -162,8 +181,9 @@ class SimState(NamedTuple):
     group_count: jax.Array  # int32 [M, K]
     overflow: jax.Array  # int32 [] group-slot overflow events (diagnostic)
     cp: Optional[jax.Array]  # int32 [M, M, M] common-prefix owner counts (exact mode)
-    own_above: Optional[jax.Array]  # int32 [M, M] own blocks above lca (fast mode)
-    own_in: Optional[jax.Array]  # int32 [M, M] own_in[j, i] = i's blocks in j's chain
+    own_cp: Optional[jax.Array]  # int32 [M, M] own blocks in lca(i, j) (fast; diag stale)
+    own_in: Optional[jax.Array]  # int32 [M, M] own_in[j, i] = i's blocks in j's chain (diag stale)
+    own_cnt: Optional[jax.Array]  # int32 [M] own blocks in own chain (fast mode authority)
 
 
 def init_state(n_miners: int, group_slots: int, exact: bool) -> SimState:
@@ -180,8 +200,9 @@ def init_state(n_miners: int, group_slots: int, exact: bool) -> SimState:
         group_count=jnp.zeros((m, k), I32),
         overflow=jnp.zeros((), I32),
         cp=jnp.zeros((m, m, m), I32) if exact else None,
-        own_above=None if exact else jnp.zeros((m, m), I32),
+        own_cp=None if exact else jnp.zeros((m, m), I32),
         own_in=None if exact else jnp.zeros((m, m), I32),
+        own_cnt=None if exact else jnp.zeros((m,), I32),
     )
 
 
@@ -326,14 +347,15 @@ def found_block(
     height = state.height + onehot_w.astype(I32)
 
     cp = state.cp
-    own_above, own_in = state.own_above, state.own_in
+    own_cnt = state.own_cnt
     w32 = onehot_w.astype(I32)
     if cp is not None:
         cp = cp + w32[:, None, None] * w32[None, :, None] * w32[None, None, :]
     else:
-        # The new block is above every lca with other miners.
-        own_above = own_above + (onehot_w[:, None] & ~onehot_w[None, :]).astype(I32)
-        own_in = own_in + w32[:, None] * w32[None, :]
+        # The new block is above every lca and inside no common prefix: only
+        # the own-count vector moves. own_cp / own_in diagonals go stale here
+        # by design (module docstring) — own_cnt is their authority.
+        own_cnt = own_cnt + w32
 
     return state._replace(
         height=height,
@@ -342,8 +364,7 @@ def found_block(
         group_count=cnt,
         overflow=state.overflow + over,
         cp=cp,
-        own_above=own_above,
-        own_in=own_in,
+        own_cnt=own_cnt,
     )
 
 
@@ -425,7 +446,7 @@ def notify(
     unpub_b = _at(state.height, onehot_b) - best_h
 
     cp = state.cp
-    own_above, own_in = state.own_above, state.own_in
+    own_cp, own_in, own_cnt = state.own_cp, state.own_in, state.own_cnt
     if cp is not None:
         eye = jnp.eye(m, dtype=I32)
         # cp[i, i, i]: own blocks in own chain.
@@ -457,20 +478,33 @@ def notify(
             ),
         )
     else:
-        own_above_b = jnp.sum(own_above * b32[None, :], axis=-1, dtype=I32)  # [M] = own_above[:, b]
+        cnt_b = _at(own_cnt, onehot_b)  # own_cnt[b], the authoritative diagonal
+        # own_cp[:, b] with the stored (stale) [b, b] entry corrected to
+        # own_cnt[b]: b's whole chain is its own common prefix with itself.
+        oc_b = jnp.sum(own_cp * b32[None, :], axis=-1, dtype=I32)
+        oc_b = oc_b + b32 * (cnt_b - _at(oc_b, onehot_b))
+        own_above_b = own_cnt - oc_b  # [M] = own blocks above lca(:, b)
         stale = state.stale + jnp.where(adopt, own_above_b, 0)
-        # Adopter rows: own blocks above any lca become 0 (chain is b_pub, a
-        # prefix-free copy). Columns toward adopters copy the column toward b
-        # — except for b's own row: the adopter holds b's *published* prefix,
-        # so b's unpublished suffix sits above the fork and must be counted
-        # (the pairwise analogue of the exact branch's cpb_pub subtraction;
-        # dropping it silently forgets b's pending blocks as future stale).
-        col_val = own_above_b + unpub_b * b32
-        oa = jnp.where(adopt[None, :], col_val[:, None], own_above)
-        own_above = jnp.where(adopt[:, None], 0, oa)
-        own_in_b = jnp.sum(own_in * b32[:, None], axis=0, dtype=I32)  # [M] = own_in[b, :]
-        own_in_bpub = own_in_b - unpub_b * b32
-        own_in = jnp.where(adopt[:, None], own_in_bpub[None, :], own_in)
+        # own_in[b, :] with the same diagonal correction, then minus b's
+        # unpublished suffix: per-owner counts of the adopted published chain.
+        # (Without the subtraction b's pending blocks would be silently
+        # forgotten as future stale — the pairwise analogue of the exact
+        # branch's cpb_pub.)
+        row_b = jnp.sum(own_in * b32[:, None], axis=0, dtype=I32)
+        row_b = row_b + b32 * (cnt_b - _at(row_b, onehot_b))
+        row_bpub = row_b - unpub_b * b32  # [M] per-owner counts of b_pub
+        # Adopter rows: the chain IS b_pub now — own blocks above any lca
+        # become 0, i.e. own_cp[i, :] = own_cnt_new[i] = row_bpub[i].
+        # Columns toward adopters: lca(i, adopted chain) = lca(i, b_pub),
+        # whose own count is own_cp[i, b] minus b's unpublished suffix.
+        col_cp = oc_b - unpub_b * b32
+        own_cp = jnp.where(
+            adopt[:, None],
+            row_bpub[:, None],
+            jnp.where(adopt[None, :], col_cp[:, None], own_cp),
+        )
+        own_in = jnp.where(adopt[:, None], row_bpub[None, :], own_in)
+        own_cnt = jnp.where(adopt, row_bpub, own_cnt)
 
     height = jnp.where(adopt, best_h, state.height)
     n_private = jnp.where(adopt, 0, n_private)
@@ -489,8 +523,9 @@ def notify(
         group_count=cnt,
         overflow=state.overflow + over,
         cp=cp,
-        own_above=own_above,
+        own_cp=own_cp,
         own_in=own_in,
+        own_cnt=own_cnt,
     )
 
 
@@ -527,7 +562,9 @@ def final_stats(state: SimState, t_end: jax.Array) -> dict[str, jax.Array]:
         cp_b = jnp.sum(state.cp * b32[:, None, None], axis=0, dtype=I32)  # [j, o] = cp[b, j, o]
         own_in_b = jnp.sum(cp_b * b32[:, None], axis=0, dtype=I32)  # [o] = cp[b, b, o]
     else:
+        # own_in[b, :], diagonal corrected from own_cnt (module docstring).
         own_in_b = jnp.sum(state.own_in * b32[:, None], axis=0, dtype=I32)
+        own_in_b = own_in_b + b32 * (_at(state.own_cnt, onehot_b) - _at(own_in_b, onehot_b))
     unpub_b = _at(state.height, onehot_b) - best_h
     found = own_in_b - unpub_b * b32
     denom = jnp.maximum(best_h, 1).astype(jnp.float32)

@@ -132,11 +132,12 @@ def state_from_chains(
 
     cp = _common_prefix_owner_counts(chains, m)
     own_in = np.zeros((m, m), np.int32)
-    own_above = np.zeros((m, m), np.int32)
+    own_cp = np.zeros((m, m), np.int32)
     for i in range(m):
         for owner, _ in chains[i]:
             own_in[i, owner] += 1
-        own_above[i, :] = own_in[i, i] - cp[i, :, i]
+        own_cp[i, :] = cp[i, :, i]
+    own_cnt = np.diagonal(own_in).copy()
 
     pub_len = [len(ch) - int(n_private[i]) - int(group_count[i].sum()) for i, ch in enumerate(chains)]
     return SimState(
@@ -153,8 +154,9 @@ def state_from_chains(
         group_count=jnp.asarray(group_count),
         overflow=jnp.zeros((), I32),
         cp=jnp.asarray(cp) if exact else None,
-        own_above=None if exact else jnp.asarray(own_above),
+        own_cp=None if exact else jnp.asarray(own_cp),
         own_in=None if exact else jnp.asarray(own_in),
+        own_cnt=None if exact else jnp.asarray(own_cnt),
     )
 
 
@@ -184,6 +186,20 @@ def canonical_view(state: SimState, t: int) -> dict:
                 expand += [a] * cnt
         arrivals.append(expand)
         base_eff.append(tip)
+    if state.own_cp is None:
+        own_above = own_in = own_cnt = None
+    else:
+        # Fast-mode pairwise arrays with their non-authoritative diagonals
+        # replaced from own_cnt (tpusim.state module docstring), and the
+        # derived own-blocks-above-lca matrix the stale accounting uses.
+        ocp = np.asarray(state.own_cp).copy()
+        oin = np.asarray(state.own_in).copy()
+        ocnt = np.asarray(state.own_cnt)
+        np.fill_diagonal(ocp, ocnt)
+        np.fill_diagonal(oin, ocnt)
+        own_above = (ocnt[:, None] - ocp).tolist()
+        own_in = oin.tolist()
+        own_cnt = ocnt.tolist()
     return {
         "base_tip_arrival_effective": base_eff,
         "height": np.asarray(state.height).tolist(),
@@ -191,8 +207,9 @@ def canonical_view(state: SimState, t: int) -> dict:
         "stale": np.asarray(state.stale).tolist(),
         "inflight_arrivals": arrivals,
         "cp": None if state.cp is None else np.asarray(state.cp).tolist(),
-        "own_above": None if state.own_above is None else np.asarray(state.own_above).tolist(),
-        "own_in": None if state.own_in is None else np.asarray(state.own_in).tolist(),
+        "own_above": own_above,
+        "own_in": own_in,
+        "own_cnt": own_cnt,
     }
 
 
