@@ -25,8 +25,10 @@ run_job() {
   echo "[queue] waiting for TPU backend..."
   wait_for_tpu
   echo "[queue] running: $*"
-  if ! "$@"; then
-    echo "[queue] FAILED (rc=$?): $*" >&2
+  "$@"
+  local rc=$?
+  if [ $rc -ne 0 ]; then
+    echo "[queue] FAILED (rc=$rc): $*" >&2
     exit 1
   fi
 }

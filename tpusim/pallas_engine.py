@@ -124,6 +124,17 @@ def _make_kernel(
         neg_gate = jnp.int32(int(NEG_TIME_CAP) - 1)
         icap = jnp.float32(int(INTERVAL_CAP))
 
+        # The group buffer has two trace-time implementations with identical
+        # semantics (bit-identical state; cross-checked against the scan
+        # engine): the generic K-slot one-hot machinery, and a split-slot
+        # specialization for the fast-mode default K=2. The specialization
+        # exists purely for the VPU: an (M, K, R) op tiles its minor (K, R)
+        # dims onto 8x128 vregs, so K=2 uses 2 of 8 sublanes — 75% of the
+        # vector unit idles. Carrying the slots as 2xK (M, R) arrays through
+        # the step loop instead makes every group op fully dense; ablation
+        # timing attributed ~50% of the fast step to exactly these ops.
+        fast2 = not exact and k == 2
+
         def push_groups(garr, gcnt, arrival, count, do):
             """Append an (arrival, count) group per miner where ``do`` is set
             (tpusim.state._push_groups, runs-last). ``count`` broadcasts
@@ -141,6 +152,26 @@ def _make_kernel(
             cnt3 = jnp.broadcast_to(count, merge.shape)[:, None, :]
             gcnt = jnp.where(onehot_wr, jnp.where(accum, gcnt + cnt3, cnt3), gcnt)
             return garr, gcnt, jnp.sum(overflowed.astype(I32), axis=0, keepdims=True)
+
+        def push_groups2(a0, a1, c0, c1, arrival, count, do):
+            """push_groups on split K=2 slots, all (M, R). Case-for-case
+            equal to the generic path: append to the first empty slot, merge
+            an equal-arrival push into the last occupied slot, accumulate
+            into slot 1 on overflow."""
+            e0 = c0 > 0  # slots fill left to right: c1 > 0 implies c0 > 0
+            e1 = c1 > 0
+            last_arr = jnp.where(e1, a1, a0)
+            merge = do & e0 & (last_arr == arrival)
+            overflowed = do & ~merge & e1
+            w0 = do & (~e0 | (merge & ~e1))
+            w1 = do & e0 & (e1 | ~merge)
+            accum = merge | overflowed
+            cnt = jnp.broadcast_to(count, merge.shape)
+            a0 = jnp.where(w0, arrival, a0)
+            c0 = jnp.where(w0, jnp.where(accum, c0 + cnt, cnt), c0)
+            a1 = jnp.where(w1, arrival, a1)
+            c1 = jnp.where(w1, jnp.where(accum, c1 + cnt, cnt), c1)
+            return a0, a1, c0, c1, jnp.sum(overflowed.astype(I32), axis=0, keepdims=True)
 
         def step(s, carry):
             st = dict(zip(names, carry))
@@ -197,7 +228,14 @@ def _make_kernel(
                 ocnt = st["ocnt"] + owi
 
             arrival = t + prop  # (M, R)
-            garr, gcnt, over = push_groups(garr, gcnt, arrival, push_count, push_do)
+            if fast2:
+                a0, a1 = st["garr"]
+                c0, c1 = st["gcnt"]
+                a0, a1, c0, c1, over = push_groups2(
+                    a0, a1, c0, c1, arrival, push_count, push_do
+                )
+            else:
+                garr, gcnt, over = push_groups(garr, gcnt, arrival, push_count, push_do)
             ovf = ovf + over
             height = height + owi
             nbt = jnp.where(found_due, t + dt, nbt)
@@ -207,20 +245,31 @@ def _make_kernel(
             # no-op, and the reveal/adopt masks carry the gate.
             do = active & ~(found_due & (nbt == t))
             t_flush = jnp.where(do, t, neg_gate)  # (1, R)
-            arrived = garr <= t_flush[:, None, :]  # (M, K, R)
-            n_f = jnp.sum(arrived.astype(I32), axis=1)  # (M, R)
-            onehot_tip = kidx == (n_f - 1)[:, None, :]
-            flushed_tip = jnp.sum(jnp.where(onehot_tip, garr, 0), axis=1)
-            base = jnp.where(n_f > 0, flushed_tip, base)
-            # Compact: shifted[m, d] = garr[m, d + n_f[m]] via a K x K
-            # one-hot sel[m, d, s] = (s == d + n_f[m]); src K rides axis 2.
-            sel = kidx[:, None, :, :] == (kidx[:, :, None, :] + n_f[:, None, None, :])
-            garr = jnp.sum(jnp.where(sel, garr[:, None, :, :], 0), axis=2)
-            garr = jnp.where(jnp.any(sel, axis=2), garr, inf)
-            gcnt = jnp.sum(jnp.where(sel, gcnt[:, None, :, :], 0), axis=2)
+            if fast2:
+                # Split-slot flush: sortedness (a0 <= a1 when both live, INF
+                # in empty slots) makes the arrived set {f0, f0&f1}.
+                f0 = a0 <= t_flush  # (M, R)
+                f1 = a1 <= t_flush
+                base = jnp.where(f1, a1, jnp.where(f0, a0, base))
+                a0, a1 = jnp.where(f1, inf, jnp.where(f0, a1, a0)), jnp.where(f0, inf, a1)
+                c0, c1 = jnp.where(f1, 0, jnp.where(f0, c1, c0)), jnp.where(f0, 0, c1)
+                unarrived = c0 + c1
+            else:
+                arrived = garr <= t_flush[:, None, :]  # (M, K, R)
+                n_f = jnp.sum(arrived.astype(I32), axis=1)  # (M, R)
+                onehot_tip = kidx == (n_f - 1)[:, None, :]
+                flushed_tip = jnp.sum(jnp.where(onehot_tip, garr, 0), axis=1)
+                base = jnp.where(n_f > 0, flushed_tip, base)
+                # Compact: shifted[m, d] = garr[m, d + n_f[m]] via a K x K
+                # one-hot sel[m, d, s] = (s == d + n_f[m]); src K rides axis 2.
+                sel = kidx[:, None, :, :] == (kidx[:, :, None, :] + n_f[:, None, None, :])
+                garr = jnp.sum(jnp.where(sel, garr[:, None, :, :], 0), axis=2)
+                garr = jnp.where(jnp.any(sel, axis=2), garr, inf)
+                gcnt = jnp.sum(jnp.where(sel, gcnt[:, None, :, :], 0), axis=2)
+                unarrived = jnp.sum(gcnt, axis=1)
 
             # Best published chain, first-seen tiebreak (main.cpp:68-82).
-            pub = height - jnp.sum(gcnt, axis=1)  # (M, R)
+            pub = height - unarrived  # (M, R)
             if exact:
                 pub = pub - npriv
             best_h = jnp.max(pub, axis=0, keepdims=True)  # (1, R)
@@ -300,27 +349,53 @@ def _make_kernel(
                 ocnt = jnp.where(adopt, row_bpub, ocnt)
 
             height = jnp.where(adopt, best_h, height)
-            garr = jnp.where(adopt[:, None, :], inf, garr)
-            gcnt = jnp.where(adopt[:, None, :], 0, gcnt)
             base = jnp.where(adopt, best_tip, base)
-
-            # Cut-through (main.cpp:173-182).
-            pending = jnp.where(garr > t[:, None, :], garr, inf)
-            earliest = jnp.min(pending, axis=(0, 1))[None, :]  # (1, R)
+            if fast2:
+                a0 = jnp.where(adopt, inf, a0)
+                a1 = jnp.where(adopt, inf, a1)
+                c0 = jnp.where(adopt, 0, c0)
+                c1 = jnp.where(adopt, 0, c1)
+                # Cut-through (main.cpp:173-182).
+                p0 = jnp.where(a0 > t, a0, inf)
+                p1 = jnp.where(a1 > t, a1, inf)
+                earliest = jnp.min(jnp.minimum(p0, p1), axis=0)[None, :]  # (1, R)
+            else:
+                garr = jnp.where(adopt[:, None, :], inf, garr)
+                gcnt = jnp.where(adopt[:, None, :], 0, gcnt)
+                # Cut-through (main.cpp:173-182).
+                pending = jnp.where(garr > t[:, None, :], garr, inf)
+                earliest = jnp.min(pending, axis=(0, 1))[None, :]  # (1, R)
             t = jnp.where(active, jnp.maximum(jnp.minimum(nbt, earliest), t), t)
 
             st.update(t=t, nbt=nbt, height=height, stale=stale, base=base,
-                      garr=garr, gcnt=gcnt, ovf=ovf)
+                      ovf=ovf)
+            if fast2:
+                st.update(garr=(a0, a1), gcnt=(c0, c1))
+            else:
+                st.update(garr=garr, gcnt=gcnt)
             if exact:
                 st.update(npriv=npriv, bhp=bhp, cp=cp)
             else:
                 st.update(ocp=ocp, oin=oin, ocnt=ocnt)
             return tuple(st[name] for name in names)
 
-        carry = tuple(ref[...] for ref in outs)
+        def load(ref, name):
+            val = ref[...]
+            if fast2 and name in ("garr", "gcnt"):
+                return (val[:, 0, :], val[:, 1, :])
+            return val
+
+        def stored(val, name):
+            if fast2 and name in ("garr", "gcnt"):
+                # Rebuild the (M, K, R) layout with a K-broadcast select (a
+                # middle-axis concatenate does not lower in Mosaic).
+                return jnp.where(kidx == 0, val[0][:, None, :], val[1][:, None, :])
+            return val
+
+        carry = tuple(load(ref, name) for ref, name in zip(outs, names))
         carry = jax.lax.fori_loop(0, sb, step, carry)
-        for ref, val in zip(outs, carry):
-            ref[...] = val
+        for ref, val, name in zip(outs, carry, names):
+            ref[...] = stored(val, name)
 
     return kernel
 
