@@ -69,15 +69,21 @@ U32 = jnp.uint32
 _FAST_LEAVES = (
     "t", "nbt", "height", "stale", "base", "garr", "gcnt", "ocp", "oin", "ocnt", "ovf",
 )
+#: NOTE: the exact kernel's "ocp" leaf holds own_cp TRANSPOSED ([j, i] —
+#: see _state_to_kernel): every adoption-select value then reads as a
+#: plane-dim broadcast of ``cpb``; the untransposed orientation would need a
+#: sublane<->plane transpose per step, which Mosaic lowers poorly.
 _EXACT_LEAVES = (
-    "t", "nbt", "bhp", "height", "npriv", "stale", "base", "garr", "gcnt", "cp", "ovf",
+    "t", "nbt", "bhp", "height", "npriv", "stale", "base", "garr", "gcnt",
+    "cp", "ocp", "oin", "ocnt", "ovf",
 )
 
 
 def _leaf_shapes(m: int, k: int, exact: bool) -> list[tuple[int, ...]]:
     if exact:
         return [
-            (1,), (1,), (1,), (m,), (m,), (m,), (m,), (m, k), (m, k), (m, m, m), (1,),
+            (1,), (1,), (1,), (m,), (m,), (m,), (m,), (m, k), (m, k),
+            (m, m, m), (m, m), (m, m), (m,), (1,),
         ]
     return [(1,), (1,), (m,), (m,), (m,), (m, k), (m, k), (m, m), (m, m), (m,), (1,)]
 
@@ -111,12 +117,10 @@ def _make_kernel(
         selfish = selfish_ref[...] != 0  # (M, 1)
         kidx = jax.lax.broadcasted_iota(I32, (1, k, 1), 1)  # (1, K, 1)
         midx = jax.lax.broadcasted_iota(I32, (m, 1), 0)  # (M, 1)
-        # Identity masks for the cp contractions, built directly at their
-        # consumer ranks: Mosaic cannot shape-cast a 2D eye to 4D/3D
-        # ("infer-vector-layout: unsupported shape cast" on (M,M)->(M,M,1,1)).
+        # Identity mask for the cpb diagonal, built directly at its consumer
+        # rank: Mosaic cannot shape-cast a 2D eye to 3D
+        # ("infer-vector-layout: unsupported shape cast").
         iot = lambda shape, d: jax.lax.broadcasted_iota(I32, shape, d)
-        ei_j4 = iot((m, m, 1, 1), 0) == iot((m, m, 1, 1), 1)  # eye over (i, j)
-        ei_o4 = iot((m, 1, m, 1), 0) == iot((m, 1, m, 1), 2)  # eye over (i, o)
         eye3 = iot((m, m, 1), 0) == iot((m, m, 1), 1)
         # Literals, not captured jnp constants (pallas kernels cannot close
         # over device arrays).
@@ -197,7 +201,11 @@ def _make_kernel(
             u = (bi >> U32(8)).astype(I32).astype(jnp.float32) * jnp.float32(2.0**-24)
             dt = jnp.minimum(-jnp.log1p(-u) * jnp.float32(mean_interval_ms), icap).astype(I32)
 
-            # --- FoundBlock (simulation.h:62-76).
+            # --- FoundBlock (simulation.h:62-76). In both modes a find
+            # moves only the (M, R) own-count vector (tpusim.state
+            # found_block): the new block sits on the lazily-maintained
+            # diagonals, so no M^2/M^3 traffic in the hot find path.
+            ocnt = st["ocnt"] + owi
             if exact:
                 npriv, bhp, cp = st["npriv"], st["bhp"], st["cp"]
                 if any_selfish:
@@ -216,16 +224,9 @@ def _make_kernel(
                 else:
                     push_do = ow
                     push_count = I32(1)
-                cp = cp + (
-                    ow[:, None, None, :] & ow[None, :, None, :] & ow[None, None, :, :]
-                ).astype(I32)
             else:
                 push_do = ow
                 push_count = I32(1)
-                # Fast mode: a find moves only the (M, R) own-count vector
-                # (tpusim.state.found_block) — no M x M traffic in the hot
-                # find path.
-                ocnt = st["ocnt"] + owi
 
             arrival = t + prop  # (M, R)
             if fast2:
@@ -297,16 +298,32 @@ def _make_kernel(
             adopt = (best_h > height) & do  # (M, R)
             unpub_b = jnp.sum(height * b32, axis=0, keepdims=True) - best_h  # (1, R)
 
+            # Shared diagonal corrections (tpusim.state.notify): ocnt is the
+            # authority for every stale diagonal read.
+            ocp, oin = st["ocp"], st["oin"]
+            cnt_b = jnp.sum(ocnt * b32, axis=0, keepdims=True)  # (1, R)
             if exact:
-                # Closed-form cp update (tpusim.state.notify, exact branch).
-                own_self = jnp.sum(cp * (ei_j4 & ei_o4).astype(I32), axis=(1, 2))  # (M, R)
-                cp_b_cols = jnp.sum(cp * b32[None, :, None, :], axis=1)  # (M, M, R) [i, o]
-                own_common_b = jnp.sum(cp_b_cols * eye3.astype(I32), axis=1)
-                stale = stale + jnp.where(adopt, own_self - own_common_b, 0)
+                # Exact ocp is stored transposed ([j, i], see _EXACT_LEAVES);
+                # own_cp[:, b] is its b-th plane.
+                oc_b = jnp.sum(ocp * b32[:, None, :], axis=0)  # (M, R)
+            else:
+                oc_b = jnp.sum(ocp * b32[None, :, :], axis=1)  # (M, R) own_cp[:, b]
+            oc_bb = jnp.sum(oc_b * b32, axis=0, keepdims=True)
+            oc_b = oc_b + b32 * (cnt_b - oc_bb)
+            # Own blocks above lca(:, b) — reorg stale accounting.
+            stale = stale + jnp.where(adopt, ocnt - oc_b, 0)
+            row_b = jnp.sum(oin * b32[:, None, :], axis=0)  # (M, R) own_in[b, :]
+            row_bb = jnp.sum(row_b * b32, axis=0, keepdims=True)
+            row_b = row_b + b32 * (cnt_b - row_bb)
+            row_bpub = row_b - unpub_b * b32  # (M, R) composition of b_pub
 
-                cpb = jnp.sum(cp * b32[:, None, None, :], axis=0)  # (M, M, R) [j, o]
-                cpb_bb = jnp.sum(cpb * b32[:, None, :], axis=0)  # (M, R) [o]
-                cpb_pub = cpb_bb - unpub_b * b32  # (M, R)
+            if exact:
+                # cpb[j, o] = cp[b, j, o]. Its j == b row is stale (an
+                # i == j plane of the stored tensor) but every consumer
+                # below excludes it via ~onehot_b masks, so it needs no
+                # correction (tpusim.state.notify).
+                cpb = jnp.sum(cp * b32[:, None, None, :], axis=0)  # (M, M, R)
+                cpb_diag = jnp.sum(jnp.where(eye3, cpb, 0), axis=1)  # (M, R) cp[b, i, i]
                 a_i = adopt[:, None, :]
                 a_j = adopt[None, :, :]
                 is_b_i = onehot_b[:, None, :]
@@ -316,37 +333,41 @@ def _make_kernel(
                 cond_bi = ~a_i & ~is_b_i & a_j
                 cp = jnp.where(
                     cond_pub[:, :, None, :],
-                    cpb_pub[None, None, :, :],
+                    row_bpub[None, None, :, :],
                     jnp.where(
                         cond_bj[:, :, None, :],
                         cpb[None, :, :, :],
                         jnp.where(cond_bi[:, :, None, :], cpb[:, None, :, :], cp),
                     ),
                 )
+                # own_cp from the o == i slices of the same update, written
+                # in its transposed [j, i] orientation: cond_bj's value
+                # cp[b, j, i] is cpb read as (j, i) — no transpose needed
+                # (the whole point of the transposed storage).
+                aT_i = adopt[None, :, :]
+                aT_j = adopt[:, None, :]
+                bT_i = onehot_b[None, :, :]
+                bT_j = onehot_b[:, None, :]
+                condT_pub = (aT_i & (aT_j | bT_j)) | (bT_i & aT_j)
+                condT_bj = aT_i & ~aT_j & ~bT_j
+                condT_bi = ~aT_i & ~bT_i & aT_j
+                ocp = jnp.where(
+                    condT_pub,
+                    row_bpub[None, :, :],
+                    jnp.where(condT_bj, cpb, jnp.where(condT_bi, cpb_diag[None, :, :], ocp)),
+                )
                 npriv = jnp.where(adopt, 0, npriv)
                 bhp = jnp.where(do, best_h, bhp)
             else:
-                # tpusim.state.notify's fast branch: own_cp/own_in columns
-                # and rows for b, stored diagonals corrected from ocnt.
-                ocp, oin = st["ocp"], st["oin"]
-                cnt_b = jnp.sum(ocnt * b32, axis=0, keepdims=True)  # (1, R)
-                oc_b = jnp.sum(ocp * b32[None, :, :], axis=1)  # (M, R) own_cp[:, b]
-                oc_bb = jnp.sum(oc_b * b32, axis=0, keepdims=True)
-                oc_b = oc_b + b32 * (cnt_b - oc_bb)
-                oab = ocnt - oc_b  # (M, R) own blocks above lca(:, b)
-                stale = stale + jnp.where(adopt, oab, 0)
-                row_b = jnp.sum(oin * b32[:, None, :], axis=0)  # (M, R) own_in[b, :]
-                row_bb = jnp.sum(row_b * b32, axis=0, keepdims=True)
-                row_b = row_b + b32 * (cnt_b - row_bb)
-                row_bpub = row_b - unpub_b * b32
+                # Fast pairwise approximation (tpusim.state.notify).
                 col_cp = oc_b - unpub_b * b32
                 ocp = jnp.where(
                     adopt[:, None, :],
                     row_bpub[:, None, :],
                     jnp.where(adopt[None, :, :], col_cp[:, None, :], ocp),
                 )
-                oin = jnp.where(adopt[:, None, :], row_bpub[None, :, :], oin)
-                ocnt = jnp.where(adopt, row_bpub, ocnt)
+            oin = jnp.where(adopt[:, None, :], row_bpub[None, :, :], oin)
+            ocnt = jnp.where(adopt, row_bpub, ocnt)
 
             height = jnp.where(adopt, best_h, height)
             base = jnp.where(adopt, best_tip, base)
@@ -368,15 +389,13 @@ def _make_kernel(
             t = jnp.where(active, jnp.maximum(jnp.minimum(nbt, earliest), t), t)
 
             st.update(t=t, nbt=nbt, height=height, stale=stale, base=base,
-                      ovf=ovf)
+                      ovf=ovf, ocp=ocp, oin=oin, ocnt=ocnt)
             if fast2:
                 st.update(garr=(a0, a1), gcnt=(c0, c1))
             else:
                 st.update(garr=garr, gcnt=gcnt)
             if exact:
                 st.update(npriv=npriv, bhp=bhp, cp=cp)
-            else:
-                st.update(ocp=ocp, oin=oin, ocnt=ocnt)
             return tuple(st[name] for name in names)
 
         def load(ref, name):
@@ -527,7 +546,9 @@ class PallasEngine(Engine):
         return {k: head[k] + tail[k] for k in head}
 
     def _state_to_kernel(self, state: SimState):
-        """SimState (runs-first) -> ordered runs-last leaf tuple."""
+        """SimState (runs-first) -> ordered runs-last leaf tuple. The exact
+        kernel's own_cp leaf is transposed to [j, i] (see _EXACT_LEAVES);
+        the swap happens here in XLA, once per chunk."""
         tr = lambda x: jnp.moveaxis(x, 0, -1)
         if self.exact:
             return (
@@ -535,7 +556,9 @@ class PallasEngine(Engine):
                 state.best_height_prev[None, :],
                 tr(state.height), tr(state.n_private), tr(state.stale),
                 tr(state.base_tip_arrival), tr(state.group_arrival),
-                tr(state.group_count), tr(state.cp), state.overflow[None, :],
+                tr(state.group_count), tr(state.cp),
+                tr(state.own_cp).swapaxes(0, 1), tr(state.own_in),
+                tr(state.own_cnt), state.overflow[None, :],
             )
         return (
             state.t[None, :], state.next_block_time[None, :],
@@ -548,12 +571,15 @@ class PallasEngine(Engine):
     def _state_from_kernel(self, state: SimState, out) -> SimState:
         bk = lambda x: jnp.moveaxis(x, -1, 0)
         if self.exact:
-            t, nbt, bhp, height, npriv, stale, base, garr, gcnt, cp, ovf = out
+            (t, nbt, bhp, height, npriv, stale, base, garr, gcnt, cp,
+             ocp, oin, ocnt, ovf) = out
             return state._replace(
                 t=t[0], next_block_time=nbt[0], best_height_prev=bhp[0],
                 height=bk(height), n_private=bk(npriv), stale=bk(stale),
                 base_tip_arrival=bk(base), group_arrival=bk(garr),
-                group_count=bk(gcnt), cp=bk(cp), overflow=ovf[0],
+                group_count=bk(gcnt), cp=bk(cp),
+                own_cp=bk(ocp.swapaxes(0, 1)), own_in=bk(oin),
+                own_cnt=bk(ocnt), overflow=ovf[0],
             )
         t, nbt, height, stale, base, garr, gcnt, ocp, oin, ocnt, ovf = out
         return state._replace(

@@ -18,41 +18,42 @@ is collapsed into fixed-shape integers per (run, miner):
                             into ``base_tip_arrival``.
   * ``base_tip_arrival``  — arrival time of the highest *arrived* block; the
                             first-seen tiebreak key (main.cpp:74-76).
-  * ``cp[i, j, o]``       — the consensus sufficient statistic: the number of
-                            blocks owned by miner ``o`` inside the common prefix
-                            of miner ``i``'s and miner ``j``'s chains. This one
-                            tensor replaces every structural chain comparison:
-                            - reorg stale accounting (simulation.h:124-142):
-                              blocks of ``i`` popped when adopting best owner
-                              ``b``'s chain = ``cp[i,i,i] - cp[i,b,i]``;
-                            - final per-miner stats against the best chain
-                              (main.cpp:22-30): ``i``'s blocks in ``b``'s
-                              published chain = ``cp[b,b,i]`` minus ``b``'s
-                              unpublished tail when ``i == b``.
-                            The update rules below are closed under the two
-                            events of the system (own-append, adopt-published),
-                            so the representation is exact — see
-                            tests/test_state_equivalence.py which checks it
-                            against a literal chain simulator on random runs.
+  * ``cp[i, j, o]``       — (exact mode) the consensus sufficient statistic:
+                            the number of blocks owned by miner ``o`` inside
+                            the common prefix of miner ``i``'s and ``j``'s
+                            chains. This one tensor replaces every structural
+                            chain comparison. Its update rules are closed
+                            under the two events of the system (own-append,
+                            adopt-published), so the representation is exact —
+                            see tests/test_state_equivalence.py which checks
+                            it against a literal chain simulator.
+  * ``own_cnt[i]``        — own blocks in own chain, ``cp[i,i,i]``.
+  * ``own_in[j, o]``      — ``o``'s blocks in ``j``'s chain, ``cp[j,j,o]``:
+                            final per-miner stats against the best chain
+                            (main.cpp:22-30) are ``own_in[b, i]`` minus
+                            ``b``'s unpublished tail when ``i == b``.
+  * ``own_cp[i, j]``      — own blocks in the common prefix with ``j``,
+                            ``cp[i,j,i]``: reorg stale accounting
+                            (simulation.h:124-142) pops
+                            ``own_cnt[i] - own_cp[i,b]`` blocks of an
+                            adopter ``i``.
 
-A cheaper pairwise variant ("fast" mode) drops the 3-index tensor. It keeps
-``own_cnt[i]`` (own blocks in own chain), ``own_cp[i,j]`` (own blocks in the
-common prefix of ``i``'s and ``j``'s chains — the ``cp[i,j,i]`` slice of the
-exact tensor) and ``own_in[j,o]`` (owner ``o``'s blocks in ``j``'s chain);
-the derived quantity ``own_above[i,j] = own_cnt[i] - own_cp[i,j]`` (own
-blocks above the lca) drives stale accounting. With this split a block find
-touches ONLY the length-M ``own_cnt`` (a new own block is above every lca
-and inside no common prefix), so no M x M array is written outside the
-adoption sweeps — roughly half the M^2-sized work per event versus
-maintaining ``own_above`` directly. (Measured on v5e the step is
-latency-bound, not element-bound, so this is throughput-neutral there; the
-representation is kept because it is the exact tensor's ``cp[i,j,i]`` slice
-— one semantics for both modes — and the reduced per-event footprint is
-what a wider-vector or multi-core backend would want.) The diagonals of
-``own_cp`` / ``own_in`` are NOT maintained by finds (``own_cnt`` is the
-authority for both); every read corrects the ``i == b`` entry
-arithmetically and adoption rewrites make the stored diagonal consistent
-again.
+**Lazy diagonals — the perf keystone of both modes.** A block find appends
+at ``cp[w,w,w]`` = ``own_cp[w,w]`` = ``own_in[w,w]`` — always on a
+diagonal. Those diagonals are therefore NOT maintained: ``own_cnt`` (a
+length-M vector) is their single authority, finds increment ONLY it, and
+every read of a stale diagonal (``own_cp[b,b]``, ``own_in[b,b]``, the
+``i == j`` planes of ``cp`` through ``cp[b,b,o]``) corrects the entry
+arithmetically from ``own_cnt``/``own_in``. Adoption sweeps rewrite rows
+and columns with authoritative values. Net effect: the hot find path
+touches O(M) state in fast mode and O(M) in exact mode (previously O(M^3):
+the three-way one-hot ``cp`` increment), and the per-sweep M^3 work drops
+to one ``cp[b, :, :]`` contraction plus the three-way adoption select.
+
+"Fast" mode drops the 3-index tensor and keeps only ``own_cnt`` /
+``own_in`` / ``own_cp``, accepting an approximation in ``own_cp``'s
+adoption update (an adopter's rows are reset as if its new chain shared no
+history with third parties).
 
 Accuracy contract of fast mode, for honest rosters (property-tested on
 adversarial streams in tests/test_property_equivalence.py):
@@ -180,10 +181,11 @@ class SimState(NamedTuple):
     group_arrival: jax.Array  # int32 [M, K] in-flight own block groups (sorted)
     group_count: jax.Array  # int32 [M, K]
     overflow: jax.Array  # int32 [] group-slot overflow events (diagnostic)
-    cp: Optional[jax.Array]  # int32 [M, M, M] common-prefix owner counts (exact mode)
-    own_cp: Optional[jax.Array]  # int32 [M, M] own blocks in lca(i, j) (fast; diag stale)
-    own_in: Optional[jax.Array]  # int32 [M, M] own_in[j, i] = i's blocks in j's chain (diag stale)
-    own_cnt: Optional[jax.Array]  # int32 [M] own blocks in own chain (fast mode authority)
+    cp: Optional[jax.Array]  # int32 [M, M, M] common-prefix owner counts (exact mode;
+    #   the i == j planes are stale — own_in / own_cnt are their authority)
+    own_cp: jax.Array  # int32 [M, M] own blocks in lca(i, j) = cp[i, j, i] (diag stale)
+    own_in: jax.Array  # int32 [M, M] own_in[j, i] = i's blocks in j's chain = cp[j, j, i] (diag stale)
+    own_cnt: jax.Array  # int32 [M] own blocks in own chain = cp[i, i, i] (the authority)
 
 
 def init_state(n_miners: int, group_slots: int, exact: bool) -> SimState:
@@ -200,9 +202,9 @@ def init_state(n_miners: int, group_slots: int, exact: bool) -> SimState:
         group_count=jnp.zeros((m, k), I32),
         overflow=jnp.zeros((), I32),
         cp=jnp.zeros((m, m, m), I32) if exact else None,
-        own_cp=None if exact else jnp.zeros((m, m), I32),
-        own_in=None if exact else jnp.zeros((m, m), I32),
-        own_cnt=None if exact else jnp.zeros((m,), I32),
+        own_cp=jnp.zeros((m, m), I32),
+        own_in=jnp.zeros((m, m), I32),
+        own_cnt=jnp.zeros((m,), I32),
     )
 
 
@@ -346,16 +348,12 @@ def found_block(
     )
     height = state.height + onehot_w.astype(I32)
 
-    cp = state.cp
-    own_cnt = state.own_cnt
-    w32 = onehot_w.astype(I32)
-    if cp is not None:
-        cp = cp + w32[:, None, None] * w32[None, :, None] * w32[None, None, :]
-    else:
-        # The new block is above every lca and inside no common prefix: only
-        # the own-count vector moves. own_cp / own_in diagonals go stale here
-        # by design (module docstring) — own_cnt is their authority.
-        own_cnt = own_cnt + w32
+    # The new block is above every lca and inside no common prefix: only the
+    # own-count vector moves, in BOTH modes. The new block sits at
+    # cp[w, w, w] / own_cp[w, w] / own_in[w, w] — all on the lazily-maintained
+    # diagonals whose authority is own_cnt (module docstring) — so a find
+    # touches no M^2 or M^3 state at all.
+    own_cnt = state.own_cnt + onehot_w.astype(I32)
 
     return state._replace(
         height=height,
@@ -363,7 +361,6 @@ def found_block(
         group_arrival=arr,
         group_count=cnt,
         overflow=state.overflow + over,
-        cp=cp,
         own_cnt=own_cnt,
     )
 
@@ -447,20 +444,36 @@ def notify(
 
     cp = state.cp
     own_cp, own_in, own_cnt = state.own_cp, state.own_in, state.own_cnt
+
+    # Shared between the modes (diagonal corrections per the module
+    # docstring — own_cnt is the authority for every stale diagonal read):
+    cnt_b = _at(own_cnt, onehot_b)  # own chain length in blocks of b
+    # own_cp[:, b] = cp[i, b, i] with the stored (stale) [b, b] entry
+    # corrected: own blocks in the common prefix with b.
+    oc_b = jnp.sum(own_cp * b32[None, :], axis=-1, dtype=I32)
+    oc_b = oc_b + b32 * (cnt_b - _at(oc_b, onehot_b))
+    # Reorg stale accounting (simulation.h:129-135): own blocks above the
+    # lca with b are popped on adoption.
+    stale = state.stale + jnp.where(adopt, own_cnt - oc_b, 0)
+    # own_in[b, :] = cp[b, b, o] with the same diagonal correction, then
+    # minus b's unpublished suffix: per-owner composition of the adopted
+    # published chain. (Without the subtraction b's pending blocks would be
+    # silently forgotten as future stale.)
+    row_b = jnp.sum(own_in * b32[:, None], axis=0, dtype=I32)
+    row_b = row_b + b32 * (cnt_b - _at(row_b, onehot_b))
+    row_bpub = row_b - unpub_b * b32  # [M] per-owner counts of b_pub
+
     if cp is not None:
-        eye = jnp.eye(m, dtype=I32)
-        # cp[i, i, i]: own blocks in own chain.
-        own_self = jnp.sum(cp * eye[:, :, None] * eye[:, None, :], axis=(1, 2), dtype=I32)
-        # cp[i, b, i]: own blocks in the common prefix with b.
-        cp_b_cols = jnp.sum(cp * b32[None, :, None], axis=1, dtype=I32)  # [i, o] = cp[i, b, o]
-        own_common_b = jnp.sum(cp_b_cols * eye, axis=1, dtype=I32)
-        stale = state.stale + jnp.where(adopt, own_self - own_common_b, 0)
+        # cpb[j, o] = cp[b, j, o]. Its j == b row comes from a stale i == j
+        # plane of the stored tensor, but every consumer below excludes it
+        # (cond_bj/cond_bi and their transposes all carry ~onehot_b; the
+        # cond_pub value row_bpub is derived from own_in instead), so no
+        # correction is needed.
+        cpb = jnp.sum(cp * b32[:, None, None], axis=0, dtype=I32)  # [M, M]
+        cpb_diag = jnp.sum(cpb * jnp.eye(m, dtype=I32), axis=1, dtype=I32)  # [i] = cp[b, i, i]
 
         # Closed-form cp update: every adopter's chain becomes b's published
-        # chain; see module docstring for the case analysis.
-        cpb = jnp.sum(cp * b32[:, None, None], axis=0, dtype=I32)  # [M, M]: cp[b, j, o]
-        cpb_bb = jnp.sum(cpb * b32[:, None], axis=0, dtype=I32)  # [M]: cp[b, b, o]
-        cpb_pub = cpb_bb - unpub_b * b32
+        # chain; case analysis in the conds below.
         is_b_i = onehot_b[:, None]
         is_b_j = onehot_b[None, :]
         a_i = adopt[:, None]
@@ -470,41 +483,36 @@ def notify(
         cond_bi = ~a_i & ~is_b_i & a_j
         cp = jnp.where(
             cond_pub[:, :, None],
-            cpb_pub[None, None, :],
+            row_bpub[None, None, :],
             jnp.where(
                 cond_bj[:, :, None],
                 cpb[None, :, :],
                 jnp.where(cond_bi[:, :, None], cpb[:, None, :], cp),
             ),
         )
+        # The o == i slices of the same update keep own_cp exact:
+        # cond_pub -> row_bpub[i]; cond_bj -> cp[b, j, i] = cpb[j, i] (the
+        # transpose); cond_bi -> cp[b, i, i] = diag(cpb).
+        own_cp = jnp.where(
+            cond_pub,
+            row_bpub[:, None],
+            jnp.where(cond_bj, cpb.T, jnp.where(cond_bi, cpb_diag[:, None], own_cp)),
+        )
     else:
-        cnt_b = _at(own_cnt, onehot_b)  # own_cnt[b], the authoritative diagonal
-        # own_cp[:, b] with the stored (stale) [b, b] entry corrected to
-        # own_cnt[b]: b's whole chain is its own common prefix with itself.
-        oc_b = jnp.sum(own_cp * b32[None, :], axis=-1, dtype=I32)
-        oc_b = oc_b + b32 * (cnt_b - _at(oc_b, onehot_b))
-        own_above_b = own_cnt - oc_b  # [M] = own blocks above lca(:, b)
-        stale = state.stale + jnp.where(adopt, own_above_b, 0)
-        # own_in[b, :] with the same diagonal correction, then minus b's
-        # unpublished suffix: per-owner counts of the adopted published chain.
-        # (Without the subtraction b's pending blocks would be silently
-        # forgotten as future stale — the pairwise analogue of the exact
-        # branch's cpb_pub.)
-        row_b = jnp.sum(own_in * b32[:, None], axis=0, dtype=I32)
-        row_b = row_b + b32 * (cnt_b - _at(row_b, onehot_b))
-        row_bpub = row_b - unpub_b * b32  # [M] per-owner counts of b_pub
-        # Adopter rows: the chain IS b_pub now — own blocks above any lca
-        # become 0, i.e. own_cp[i, :] = own_cnt_new[i] = row_bpub[i].
-        # Columns toward adopters: lca(i, adopted chain) = lca(i, b_pub),
-        # whose own count is own_cp[i, b] minus b's unpublished suffix.
+        # Fast pairwise approximation. Adopter rows: the chain IS b_pub now
+        # — own blocks above any lca become 0, i.e. own_cp[i, :] =
+        # own_cnt_new[i] = row_bpub[i]. Columns toward adopters: lca(i,
+        # adopted chain) = lca(i, b_pub), whose own count is own_cp[i, b]
+        # minus b's unpublished suffix.
         col_cp = oc_b - unpub_b * b32
         own_cp = jnp.where(
             adopt[:, None],
             row_bpub[:, None],
             jnp.where(adopt[None, :], col_cp[:, None], own_cp),
         )
-        own_in = jnp.where(adopt[:, None], row_bpub[None, :], own_in)
-        own_cnt = jnp.where(adopt, row_bpub, own_cnt)
+
+    own_in = jnp.where(adopt[:, None], row_bpub[None, :], own_in)
+    own_cnt = jnp.where(adopt, row_bpub, own_cnt)
 
     height = jnp.where(adopt, best_h, state.height)
     n_private = jnp.where(adopt, 0, n_private)
@@ -558,13 +566,10 @@ def final_stats(state: SimState, t_end: jax.Array) -> dict[str, jax.Array]:
     onehot_b = winners & (jnp.cumsum(winners.astype(I32)) == 1)
     b32 = onehot_b.astype(I32)
 
-    if state.cp is not None:
-        cp_b = jnp.sum(state.cp * b32[:, None, None], axis=0, dtype=I32)  # [j, o] = cp[b, j, o]
-        own_in_b = jnp.sum(cp_b * b32[:, None], axis=0, dtype=I32)  # [o] = cp[b, b, o]
-    else:
-        # own_in[b, :], diagonal corrected from own_cnt (module docstring).
-        own_in_b = jnp.sum(state.own_in * b32[:, None], axis=0, dtype=I32)
-        own_in_b = own_in_b + b32 * (_at(state.own_cnt, onehot_b) - _at(own_in_b, onehot_b))
+    # own_in[b, :] = cp[b, b, o] in both modes, diagonal corrected from
+    # own_cnt (module docstring): the best chain's per-owner composition.
+    own_in_b = jnp.sum(state.own_in * b32[:, None], axis=0, dtype=I32)
+    own_in_b = own_in_b + b32 * (_at(state.own_cnt, onehot_b) - _at(own_in_b, onehot_b))
     unpub_b = _at(state.height, onehot_b) - best_h
     found = own_in_b - unpub_b * b32
     denom = jnp.maximum(best_h, 1).astype(jnp.float32)

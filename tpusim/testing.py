@@ -154,9 +154,9 @@ def state_from_chains(
         group_count=jnp.asarray(group_count),
         overflow=jnp.zeros((), I32),
         cp=jnp.asarray(cp) if exact else None,
-        own_cp=None if exact else jnp.asarray(own_cp),
-        own_in=None if exact else jnp.asarray(own_in),
-        own_cnt=None if exact else jnp.asarray(own_cnt),
+        own_cp=jnp.asarray(own_cp),
+        own_in=jnp.asarray(own_in),
+        own_cnt=jnp.asarray(own_cnt),
     )
 
 
@@ -186,30 +186,34 @@ def canonical_view(state: SimState, t: int) -> dict:
                 expand += [a] * cnt
         arrivals.append(expand)
         base_eff.append(tip)
-    if state.own_cp is None:
-        own_above = own_in = own_cnt = None
+    # Pairwise arrays with their non-authoritative diagonals replaced from
+    # own_cnt (tpusim.state module docstring), and the derived
+    # own-blocks-above-lca matrix the stale accounting uses.
+    ocp = np.asarray(state.own_cp).copy()
+    oin = np.asarray(state.own_in).copy()
+    ocnt = np.asarray(state.own_cnt)
+    np.fill_diagonal(ocp, ocnt)
+    np.fill_diagonal(oin, ocnt)
+    own_above = (ocnt[:, None] - ocp).tolist()
+    if state.cp is None:
+        cp = None
     else:
-        # Fast-mode pairwise arrays with their non-authoritative diagonals
-        # replaced from own_cnt (tpusim.state module docstring), and the
-        # derived own-blocks-above-lca matrix the stale accounting uses.
-        ocp = np.asarray(state.own_cp).copy()
-        oin = np.asarray(state.own_in).copy()
-        ocnt = np.asarray(state.own_cnt)
-        np.fill_diagonal(ocp, ocnt)
-        np.fill_diagonal(oin, ocnt)
-        own_above = (ocnt[:, None] - ocp).tolist()
-        own_in = oin.tolist()
-        own_cnt = ocnt.tolist()
+        # Canonicalize the exact tensor's lazily-maintained i == j planes
+        # (their authority is own_in, diagonal from own_cnt).
+        cp = np.asarray(state.cp).copy()
+        for i in range(m):
+            cp[i, i, :] = oin[i]
+        cp = cp.tolist()
     return {
         "base_tip_arrival_effective": base_eff,
         "height": np.asarray(state.height).tolist(),
         "n_private": np.asarray(state.n_private).tolist(),
         "stale": np.asarray(state.stale).tolist(),
         "inflight_arrivals": arrivals,
-        "cp": None if state.cp is None else np.asarray(state.cp).tolist(),
+        "cp": cp,
         "own_above": own_above,
-        "own_in": own_in,
-        "own_cnt": own_cnt,
+        "own_in": oin.tolist(),
+        "own_cnt": ocnt.tolist(),
     }
 
 
