@@ -1,6 +1,7 @@
 """On-TPU smoke for the Pallas engine: lower, run, cross-check vs the scan
 twin bit-for-bit, and time both. Used interactively during hardware bring-up;
-the committed artifact of these runs is PERF.md / artifacts/perf_tpu.jsonl."""
+the committed artifacts of these runs are artifacts/perf_tpu.jsonl and the
+hardware table in BASELINE.md."""
 import argparse
 import json
 import sys

@@ -34,15 +34,18 @@ SELFISH40 = default_network(
 
 
 @pytest.mark.parametrize(
-    "network,duration_ms,chunk_steps,mode",
+    "network,duration_ms,chunk_steps,mode,group_slots",
     [
-        (default_network(propagation_ms=10_000), 4 * 86_400_000, 128, "fast"),  # chunked, racy
-        (HETERO, 1_200_000, 64, "fast"),  # heterogeneous + 0 ms propagation edge
-        (default_network(propagation_ms=10_000), 2 * 86_400_000, 64, "exact"),  # exact honest
-        (SELFISH40, 4 * 86_400_000, 128, "exact"),  # gamma=0 selfish machinery
+        (default_network(propagation_ms=10_000), 4 * 86_400_000, 128, "fast", None),  # chunked, racy
+        (HETERO, 1_200_000, 64, "fast", None),  # heterogeneous + 0 ms propagation edge
+        (default_network(propagation_ms=10_000), 2 * 86_400_000, 64, "exact", None),  # exact honest
+        (SELFISH40, 4 * 86_400_000, 128, "exact", None),  # gamma=0 selfish machinery
+        # Non-default K=4 fast: covers the kernel's generic K-slot group
+        # machinery, which the K=2 default routes around (the split-slot path).
+        (default_network(propagation_ms=10_000), 2 * 86_400_000, 64, "fast", 4),
     ],
 )
-def test_pallas_matches_scan_engine_exactly(network, duration_ms, chunk_steps, mode):
+def test_pallas_matches_scan_engine_exactly(network, duration_ms, chunk_steps, mode, group_slots):
     # 160 runs with tile_runs=128: the aligned prefix takes the kernel, the
     # 32-run remainder takes the scan twin — both paths must agree with the
     # scan engine bit for bit.
@@ -53,6 +56,7 @@ def test_pallas_matches_scan_engine_exactly(network, duration_ms, chunk_steps, m
         batch_size=160,
         mode=mode,
         chunk_steps=chunk_steps,
+        group_slots=group_slots,
         seed=23,
     )
     keys = make_run_keys(config.seed, 0, config.runs)
