@@ -249,3 +249,30 @@ def test_config_validation_errors():
                 miners=(MinerConfig(hashrate_pct=100),), block_interval_s=7200.0
             )
         )
+
+
+def test_engine_override_and_pallas_cpu_fallback(caplog):
+    """engine="scan" and engine="pallas" must agree: on CPU the forced
+    Pallas engine passes construction (512 runs = one full fast-mode tile,
+    so run_batch reaches the kernel instead of the small-batch scan-twin
+    route), fails lowering at run time ("Only interpret mode is supported
+    on CPU backend"), and the runner's batch-level fallback reruns on the
+    draw-identical scan twin — so the sums come out equal and the fallback
+    is logged. An unknown engine name is rejected."""
+    config = SimConfig(
+        network=default_network(propagation_ms=1000),
+        duration_ms=86_400_000,
+        runs=512,
+        batch_size=512,
+        seed=9,
+    )
+    scan = run_simulation_config(config, engine="scan", use_all_devices=False)
+    with caplog.at_level("ERROR", logger="tpusim"):
+        via_pallas = run_simulation_config(config, engine="pallas", use_all_devices=False)
+    assert any("falling back to the scan engine" in r.message for r in caplog.records)
+    # to_json() embeds wall-clock timing; compare the statistics only.
+    assert scan.table() == via_pallas.table()
+    assert scan.overflow_total == via_pallas.overflow_total
+    assert scan.best_height_mean == via_pallas.best_height_mean
+    with pytest.raises(ValueError, match="unknown engine"):
+        run_simulation_config(config, engine="mosaic")

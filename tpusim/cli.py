@@ -65,6 +65,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint", type=Path, help="npz path for batch-level checkpoint/resume")
     p.add_argument("--json", type=Path, help="also write structured results to this path")
     p.add_argument("--single-device", action="store_true", help="disable multi-device sharding")
+    p.add_argument(
+        "--engine",
+        choices=("auto", "pallas", "scan"),
+        default="auto",
+        help="force the execution engine (pallas = single-TPU VMEM kernel, "
+        "draw-identical to scan; auto picks per platform)",
+    )
     p.add_argument("--quiet", action="store_true", help="suppress progress output")
     p.add_argument("--profile", action="store_true", help="print phase/throughput telemetry")
     p.add_argument(
@@ -120,6 +127,11 @@ def main(argv: list[str] | None = None) -> int:
                 "error: --profile/--trace-dir instrument the tpu backend; "
                 "the cpp backend reports its own elapsed time in --json output"
             )
+        if args.engine != "auto":
+            raise SystemExit(
+                "error: --engine picks the JAX execution engine; "
+                "the cpp backend has none"
+            )
         from .backend.cpp import run_simulation_cpp
 
         print(f"Running {config.runs} simulations on the native C++ backend.")
@@ -153,6 +165,7 @@ def main(argv: list[str] | None = None) -> int:
                 progress=None if args.quiet else progress,
                 checkpoint_path=args.checkpoint,
                 profiler=profiler,
+                engine=args.engine,
             )
         if not args.quiet:
             print()

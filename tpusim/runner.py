@@ -44,12 +44,20 @@ def make_engine(config: SimConfig, mesh: Mesh | None = None, prefer_pallas: bool
     which raises inside PallasEngine and falls through). The two are
     draw-for-draw identical; callers that hit a runtime failure in the
     Pallas path can rebuild a scan engine pinned to the same chunk_steps
-    and lose nothing."""
+    and lose nothing.
+
+    ``prefer_pallas=True`` is a *forced* choice: an ineligible config
+    (mesh, fast-mode selfish, xoroshiro rng, VMEM-guard refusal) raises its
+    ValueError instead of silently downgrading to the scan engine. The
+    platform-default auto preference downgrades quietly."""
+    forced = prefer_pallas is True
     if prefer_pallas is None:
         prefer_pallas = mesh is None and jax.devices()[0].platform == "tpu"
     if prefer_pallas:
         from .pallas_engine import PallasEngine
 
+        if forced:
+            return PallasEngine(config, mesh)
         try:
             return PallasEngine(config, mesh)
         except ValueError:
@@ -103,15 +111,22 @@ def run_simulation_config(
     checkpoint_path: str | Path | None = None,
     max_retries: int = 2,
     profiler: "Profiler | None" = None,
+    engine: str = "auto",
 ) -> SimResults:
     """Run ``config.runs`` simulations and aggregate their statistics.
 
     Equivalent of the reference's ``main()`` (main.cpp:195-235) minus printing.
     Runs are processed in batches of ``config.batch_size``; when more than one
     device is visible (and no explicit mesh is given) the runs axis of each
-    batch is sharded across all devices.
+    batch is sharded across all devices. ``engine`` forces the execution
+    engine: "pallas" (single-device; skips the multi-device mesh, raises on
+    an ineligible config, and falls back to the draw-identical scan twin
+    only on a runtime kernel failure), "scan", or "auto" (the platform
+    default of :func:`make_engine`).
     """
-    if mesh is None and use_all_devices and len(jax.devices()) > 1:
+    if engine not in ("auto", "pallas", "scan"):
+        raise ValueError(f"unknown engine {engine!r}; use auto, pallas or scan")
+    if mesh is None and use_all_devices and engine != "pallas" and len(jax.devices()) > 1:
         mesh = Mesh(np.array(jax.devices()), ("runs",))
 
     n_dev = 1 if mesh is None else mesh.devices.size
@@ -119,7 +134,8 @@ def run_simulation_config(
     batch -= batch % n_dev or 0
     batch = max(batch, n_dev)
 
-    engine = make_engine(config, mesh)
+    prefer_pallas = None if engine == "auto" else (engine == "pallas")
+    engine = make_engine(config, mesh, prefer_pallas=prefer_pallas)
     # A trailing remainder that doesn't fill the mesh runs on an unsharded
     # single-device engine rather than silently changing the run count.
     engine_unsharded: Engine | None = None
