@@ -25,6 +25,9 @@ def main() -> None:
                     help="explicit chunk_steps (must be a multiple of step-block; "
                          "the auto value is 64-aligned only)")
     ap.add_argument("--skip-scan", action="store_true")
+    ap.add_argument("--no-vmem-guard", action="store_true",
+                    help="bypass the VMEM footprint guard (bring-up: let the "
+                         "real compiler judge an oversized tiling)")
     args = ap.parse_args()
 
     from tpusim import SimConfig, default_network
@@ -45,7 +48,8 @@ def main() -> None:
     cfg = SimConfig(network=net, duration_ms=args.days * 86_400_000,
                     runs=args.runs, batch_size=args.runs, seed=7,
                     chunk_steps=args.chunk_steps)
-    eng = PallasEngine(cfg, tile_runs=args.tile_runs, step_block=args.step_block)
+    eng = PallasEngine(cfg, tile_runs=args.tile_runs, step_block=args.step_block,
+                       vmem_guard=not args.no_vmem_guard)
     years = args.runs * args.days / 365.2425
 
     t0 = time.time()

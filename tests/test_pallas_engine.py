@@ -101,6 +101,8 @@ def test_pallas_refuses_oversized_vmem_config():
     with pytest.raises(ValueError, match="VMEM"):
         PallasEngine(big, tile_runs=128)
     PallasEngine(big, tile_runs=128, interpret=True)  # debug path still builds
+    # The bring-up escape hatch builds too (the real compiler then judges).
+    PallasEngine(big, tile_runs=128, vmem_guard=False)
 
 
 def test_scan_twin_shares_resolved_chunk_steps_with_auto_sizing():
