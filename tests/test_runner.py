@@ -276,3 +276,14 @@ def test_engine_override_and_pallas_cpu_fallback(caplog):
     assert scan.best_height_mean == via_pallas.best_height_mean
     with pytest.raises(ValueError, match="unknown engine"):
         run_simulation_config(config, engine="mosaic")
+    # Forced pallas is strict: an ineligible config raises the engine's own
+    # error instead of silently downgrading (auto would downgrade quietly).
+    selfish_fast = dataclasses.replace(
+        config,
+        network=default_network(
+            propagation_ms=1000, selfish_ids=(0,), hashrates=(40, 19, 12, 11, 8, 5, 3, 1, 1)
+        ),
+        mode="fast",
+    )
+    with pytest.raises(ValueError, match="exact mode"):
+        run_simulation_config(selfish_fast, engine="pallas", use_all_devices=False)
