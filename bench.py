@@ -83,33 +83,22 @@ def main() -> int:
 
     try:
         # --- Phase: backend init with subprocess probes + CPU fallback
-        # (tpusim.probe: the tunneled backend can hang jax.devices() in-process).
-        from tpusim.probe import probe_backend
+        # (tpusim.probe: the tunneled backend can hang jax.devices() in-process,
+        # and probe_or_force_cpu documents why env vars alone cannot fix that).
+        from tpusim.probe import probe_or_force_cpu
 
         t0 = time.monotonic()
-        platform = probe_backend(
+        platform = probe_or_force_cpu(
             timeout_s=args.probe_timeout, retries=args.probe_retries, log=log
         )
         if platform is not None:
             log(f"backend probe ok: {platform} ({time.monotonic() - t0:.1f}s)")
         else:
-            log("accelerator backend unavailable after retries; falling back to CPU")
-            # Env vars alone are too late: this container's sitecustomize
-            # registers the tunnel PJRT plugin at interpreter startup, and
-            # the first backend touch then hangs in C land where not even
-            # the SIGALRM watchdog can interrupt (observed 2026-07-30 with
-            # a wedged tunnel). Clear the plugin trigger AND force the
-            # platform through jax.config before any backend initializes —
-            # the same approach as tests/conftest.py.
-            os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-            os.environ["JAX_PLATFORMS"] = "cpu"
+            log("accelerator backend unavailable after retries; forced local CPU")
             info["tpu_unavailable"] = True
 
         phase = "import"
         import jax
-
-        if platform is None:
-            jax.config.update("jax_platforms", "cpu")
 
         platform = jax.devices()[0].platform
         info["platform"] = platform

@@ -52,3 +52,33 @@ def probe_backend(
             say(f"retrying backend probe in {pause:.0f}s ({attempt + 1}/{retries})")
             time.sleep(pause)
     return None
+
+
+def probe_or_force_cpu(
+    timeout_s: float = 150.0,
+    retries: int = 3,
+    backoff_s: float = 10.0,
+    log: Callable[[str], None] | None = None,
+) -> str | None:
+    """Probe the accelerator; on failure, force this process onto local CPU.
+
+    Env vars alone are too late for the forcing: this container's
+    sitecustomize registers the tunnel PJRT plugin at interpreter startup,
+    so the first backend touch still goes to the dead tunnel and hangs in C
+    land — where not even a SIGALRM watchdog fires. The fallback therefore
+    clears the plugin trigger env (for child processes), sets JAX_PLATFORMS,
+    and forces the platform through ``jax.config`` — valid any time before
+    the first backend initialization, whether or not jax is imported yet.
+
+    Returns the probed platform name, or None when CPU was forced. Callers:
+    bench.py and __graft_entry__.entry (the sweep CLI instead fails loudly
+    — a silent CPU sweep would waste hours).
+    """
+    platform = probe_backend(timeout_s, retries, backoff_s, log)
+    if platform is None:
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    return platform
