@@ -29,7 +29,7 @@ run_job() {
   local rc=$?
   if [ $rc -ne 0 ]; then
     echo "[queue] FAILED (rc=$rc): $*" >&2
-    exit 1
+    exit "$rc"
   fi
 }
 

@@ -11,7 +11,9 @@ reason tests must run on local CPU.
 
 import os
 
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+from tpusim.probe import TUNNEL_TRIGGER_ENV
+
+os.environ.pop(TUNNEL_TRIGGER_ENV, None)
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:

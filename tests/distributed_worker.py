@@ -18,6 +18,8 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
 ).strip()
+# tpusim.probe.TUNNEL_TRIGGER_ENV, inlined: this standalone worker runs
+# before tpusim is importable (the launcher only sets cwd, not PYTHONPATH).
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 

@@ -4,12 +4,12 @@ from __future__ import annotations
 
 import sys
 
-from tpusim.probe import probe_backend
+from tpusim.probe import TUNNEL_TRIGGER_ENV, probe_backend
 
 
 def test_probe_reports_cpu_platform(monkeypatch):
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
-    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.delenv(TUNNEL_TRIGGER_ENV, raising=False)
     msgs = []
     assert probe_backend(timeout_s=120, retries=1, log=msgs.append) == "cpu"
     assert not msgs
@@ -17,7 +17,7 @@ def test_probe_reports_cpu_platform(monkeypatch):
 
 def test_probe_failure_returns_none_with_log(monkeypatch):
     monkeypatch.setenv("JAX_PLATFORMS", "definitely-not-a-platform")
-    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.delenv(TUNNEL_TRIGGER_ENV, raising=False)
     msgs = []
     assert probe_backend(timeout_s=120, retries=1, log=msgs.append) is None
     assert msgs and "probe failed" in msgs[0]
@@ -30,7 +30,7 @@ def test_probe_timeout_path(monkeypatch):
     # point PYTHONPATH at nothing and give the real probe far too little
     # time to even start the interpreter+jax import.
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
-    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.delenv(TUNNEL_TRIGGER_ENV, raising=False)
     msgs = []
     assert probe_backend(timeout_s=0.01, retries=1, log=msgs.append) is None
     assert msgs and "timed out" in msgs[0]

@@ -269,6 +269,11 @@ def test_engine_override_and_pallas_cpu_fallback(caplog):
     scan = run_simulation_config(config, engine="scan", use_all_devices=False)
     with caplog.at_level("ERROR", logger="tpusim"):
         via_pallas = run_simulation_config(config, engine="pallas", use_all_devices=False)
+    # Pinned assumption: jax currently refuses to lower a non-interpret
+    # pallas_call on the CPU backend, which is what exercises the runtime
+    # fallback path. If a future jax version lowers it (or fails before
+    # run_batch), this assert fires and the test must find a new way to
+    # force a runtime kernel failure — do not just delete the assert.
     assert any("falling back to the scan engine" in r.message for r in caplog.records)
     # to_json() embeds wall-clock timing; compare the statistics only.
     assert scan.table() == via_pallas.table()

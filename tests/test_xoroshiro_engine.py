@@ -43,9 +43,10 @@ def test_bit_level_ab_vs_native_backend():
     native backend on the same (seed, run) streams; the per-run ratio means
     differ only by float32-vs-double accumulation (~1e-7)."""
     from tpusim.backend.cpp import run_simulation_cpp
+    from tpusim.probe import TUNNEL_TRIGGER_ENV
 
     env = os.environ.copy()
-    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop(TUNNEL_TRIGGER_ENV, None)
     env["JAX_PLATFORMS"] = "cpu"
     env["JAX_ENABLE_X64"] = "1"
     repo = str(Path(__file__).parent.parent)

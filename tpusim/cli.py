@@ -72,6 +72,25 @@ def build_parser() -> argparse.ArgumentParser:
         help="force the execution engine (pallas = single-TPU VMEM kernel, "
         "draw-identical to scan; auto picks per platform)",
     )
+    p.add_argument(
+        "--group-slots", type=int, default=None,
+        help="in-flight arrival-group buffer slots per (run, miner); "
+        "default auto (2 fast / 4 exact). Part of the sampling identity.",
+    )
+    p.add_argument(
+        "--chunk-steps", type=int, default=None,
+        help="scan steps per jitted chunk; default auto. Part of the "
+        "sampling identity (sets the step->key mapping).",
+    )
+    p.add_argument(
+        "--tile-runs", type=int, default=None,
+        help="pallas engine: runs per kernel tile (multiple of 128); "
+        "default measured per mode (512 fast / 256 exact)",
+    )
+    p.add_argument(
+        "--step-block", type=int, default=None,
+        help="pallas engine: scan steps per kernel invocation (default 64)",
+    )
     p.add_argument("--quiet", action="store_true", help="suppress progress output")
     p.add_argument("--profile", action="store_true", help="print phase/throughput telemetry")
     p.add_argument(
@@ -82,7 +101,18 @@ def build_parser() -> argparse.ArgumentParser:
 
 def config_from_args(args: argparse.Namespace) -> SimConfig:
     if args.config:
-        return SimConfig.from_json(args.config.read_text())
+        config = SimConfig.from_json(args.config.read_text())
+        # Sampling-identity flags still apply on top of a config file —
+        # silently dropping them would let a fingerprint "confirm" an
+        # identity the user believes they overrode.
+        import dataclasses
+
+        overrides = {}
+        if args.group_slots is not None:
+            overrides["group_slots"] = args.group_slots
+        if args.chunk_steps is not None:
+            overrides["chunk_steps"] = args.chunk_steps
+        return dataclasses.replace(config, **overrides) if overrides else config
     hashrates = [int(x) for x in args.hashrates.split(",")]
     props = [int(x) for x in args.propagation_ms.split(",")]
     if len(props) == 1:
@@ -98,6 +128,10 @@ def config_from_args(args: argparse.Namespace) -> SimConfig:
     kwargs = {}
     if args.batch_size is not None:
         kwargs["batch_size"] = args.batch_size
+    if args.group_slots is not None:
+        kwargs["group_slots"] = args.group_slots
+    if args.chunk_steps is not None:
+        kwargs["chunk_steps"] = args.chunk_steps
     return SimConfig(
         network=NetworkConfig(miners=miners, block_interval_s=args.block_interval_s),
         duration_ms=duration_ms,
@@ -130,6 +164,11 @@ def main(argv: list[str] | None = None) -> int:
         if args.engine != "auto":
             raise SystemExit(
                 "error: --engine picks the JAX execution engine; "
+                "the cpp backend has none"
+            )
+        if args.tile_runs is not None or args.step_block is not None:
+            raise SystemExit(
+                "error: --tile-runs/--step-block tune the pallas kernel; "
                 "the cpp backend has none"
             )
         from .backend.cpp import run_simulation_cpp
@@ -166,6 +205,8 @@ def main(argv: list[str] | None = None) -> int:
                 checkpoint_path=args.checkpoint,
                 profiler=profiler,
                 engine=args.engine,
+                tile_runs=args.tile_runs,
+                step_block=args.step_block,
             )
         if not args.quiet:
             print()

@@ -464,6 +464,8 @@ class PallasEngine(Engine):
             )
         if tile_runs % 128 != 0:
             raise ValueError("tile_runs must be a multiple of 128")
+        if step_block < 1:
+            raise ValueError(f"step_block must be >= 1, got {step_block}")
         # Refuse configs whose per-tile state cannot fit scoped VMEM *before*
         # handing the kernel to Mosaic: an oversized kernel (e.g. 32 miners in
         # exact mode — the cp block alone is m^3*tile*4 = 33 MB at tile 256)

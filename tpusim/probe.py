@@ -19,6 +19,12 @@ import sys
 import time
 from typing import Callable
 
+#: The env var this container's sitecustomize uses as the trigger to register
+#: the tunneled TPU PJRT plugin at interpreter startup. probe_or_force_cpu
+#: clears it so *child* processes skip the dead tunnel entirely; if the
+#: sitecustomize trigger name ever changes, update it here.
+TUNNEL_TRIGGER_ENV = "PALLAS_AXON_POOL_IPS"
+
 
 def probe_backend(
     timeout_s: float = 150.0,
@@ -76,7 +82,7 @@ def probe_or_force_cpu(
     """
     platform = probe_backend(timeout_s, retries, backoff_s, log)
     if platform is None:
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ.pop(TUNNEL_TRIGGER_ENV, None)
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
 

@@ -36,7 +36,14 @@ logger = logging.getLogger("tpusim")
 __all__ = ["run_simulation_config", "make_run_keys", "make_engine"]
 
 
-def make_engine(config: SimConfig, mesh: Mesh | None = None, prefer_pallas: bool | None = None):
+def make_engine(
+    config: SimConfig,
+    mesh: Mesh | None = None,
+    prefer_pallas: bool | None = None,
+    *,
+    tile_runs: int | None = None,
+    step_block: int | None = None,
+):
     """Pick the fastest engine for the platform: the Pallas VMEM kernel
     (tpusim.pallas_engine) on a single TPU device — fast mode for honest
     rosters, exact mode including the selfish machinery — and the scan
@@ -49,18 +56,26 @@ def make_engine(config: SimConfig, mesh: Mesh | None = None, prefer_pallas: bool
     ``prefer_pallas=True`` is a *forced* choice: an ineligible config
     (mesh, fast-mode selfish, xoroshiro rng, VMEM-guard refusal) raises its
     ValueError instead of silently downgrading to the scan engine. The
-    platform-default auto preference downgrades quietly."""
+    platform-default auto preference downgrades quietly.
+
+    ``tile_runs``/``step_block`` override the Pallas kernel's measured
+    defaults for on-hardware sweeps (ignored by the scan engine)."""
     forced = prefer_pallas is True
     if prefer_pallas is None:
         prefer_pallas = mesh is None and jax.devices()[0].platform == "tpu"
     if prefer_pallas:
         from .pallas_engine import PallasEngine
 
-        if forced:
-            return PallasEngine(config, mesh)
+        kw = {}
+        if tile_runs is not None:
+            kw["tile_runs"] = tile_runs
+        if step_block is not None:
+            kw["step_block"] = step_block
         try:
-            return PallasEngine(config, mesh)
+            return PallasEngine(config, mesh, **kw)
         except ValueError:
+            if forced:
+                raise
             logger.info("config not eligible for the pallas engine; using scan engine")
     return Engine(config, mesh)
 
@@ -112,6 +127,8 @@ def run_simulation_config(
     max_retries: int = 2,
     profiler: "Profiler | None" = None,
     engine: str = "auto",
+    tile_runs: int | None = None,
+    step_block: int | None = None,
 ) -> SimResults:
     """Run ``config.runs`` simulations and aggregate their statistics.
 
@@ -135,7 +152,10 @@ def run_simulation_config(
     batch = max(batch, n_dev)
 
     prefer_pallas = None if engine == "auto" else (engine == "pallas")
-    engine = make_engine(config, mesh, prefer_pallas=prefer_pallas)
+    eng = make_engine(
+        config, mesh, prefer_pallas=prefer_pallas,
+        tile_runs=tile_runs, step_block=step_block,
+    )
     # A trailing remainder that doesn't fill the mesh runs on an unsharded
     # single-device engine rather than silently changing the run count.
     engine_unsharded: Engine | None = None
@@ -162,7 +182,7 @@ def run_simulation_config(
     # chunk_steps=None resolves to an engine-chosen default that may change
     # between versions; fingerprint the *resolved* value, which is what fixes
     # the step->key sampling identity.
-    fp_dict["chunk_steps"] = engine.chunk_steps
+    fp_dict["chunk_steps"] = eng.chunk_steps
     fingerprint = json.dumps(fp_dict, sort_keys=True)
     ckpt = _Checkpoint(Path(checkpoint_path), fingerprint) if checkpoint_path else None
     runs_done, sums = 0, None
@@ -179,7 +199,7 @@ def run_simulation_config(
                 engine_unsharded = Engine(config, None)
             this_engine = engine_unsharded
         else:
-            this_engine = engine
+            this_engine = eng
         if mesh is not None and jax.process_count() > 1:
             # Multi-controller: assemble the batch keys shard-by-shard so they
             # can live on a mesh containing non-addressable devices.
@@ -202,7 +222,7 @@ def run_simulation_config(
                     batch_sums = this_engine.run_batch(keys)
                 break
             except Exception as e:  # noqa: BLE001 — batch-level retry is the point
-                if not (this_engine is engine and hasattr(this_engine, "scan_twin")) \
+                if not (this_engine is eng and hasattr(this_engine, "scan_twin")) \
                         and isinstance(e, (ValueError, TypeError)):
                     # Deterministic config errors (e.g. the int32 block-count
                     # guard) are not transient: fail fast instead of retrying.
@@ -211,7 +231,7 @@ def run_simulation_config(
                     # fallback below (where a config error re-raises instantly:
                     # run_batch validates before any device work).
                     raise
-                if this_engine is engine and hasattr(this_engine, "scan_twin"):
+                if this_engine is eng and hasattr(this_engine, "scan_twin"):
                     # Pallas kernel failed at compile/run time (e.g. a Mosaic
                     # lowering gap on this TPU generation): permanently fall
                     # back to the scan twin — same resolved chunk_steps, so
@@ -221,8 +241,8 @@ def run_simulation_config(
                         "pallas engine failed at run %d; falling back to the scan engine",
                         runs_done,
                     )
-                    engine = this_engine.scan_twin()
-                    this_engine = engine
+                    eng = this_engine.scan_twin()
+                    this_engine = eng
                     continue
                 attempts += 1
                 if attempts > max_retries:

@@ -81,7 +81,10 @@ class SimResults:
             f"After running {self.runs} simulations for {self.duration_days}d each, on average:"
         ]
         for ms in self.miners:
-            found_int = int(ms.blocks_found_mean * self.runs) // self.runs
+            # round(), not int(): blocks_found_mean is found_sum / runs, and
+            # the float64 product mean * runs can land 1 ulp below the exact
+            # integer sum, which int() would truncate to sum - 1.
+            found_int = round(ms.blocks_found_mean * self.runs) // self.runs
             line = (
                 f"  - Miner {ms.miner_id} ({ms.hashrate_pct}% of network hashrate) found "
                 f"{found_int} blocks i.e. {ms.blocks_share_mean * 100:g}% of blocks. "
