@@ -171,6 +171,12 @@ def main(argv: list[str] | None = None) -> int:
                 "error: --tile-runs/--step-block tune the pallas kernel; "
                 "the cpp backend has none"
             )
+        if args.group_slots is not None or args.chunk_steps is not None:
+            raise SystemExit(
+                "error: --group-slots/--chunk-steps pin the JAX engine's "
+                "sampling identity; the cpp backend's sequential sampling "
+                "has neither"
+            )
         from .backend.cpp import run_simulation_cpp
 
         print(f"Running {config.runs} simulations on the native C++ backend.")

@@ -64,6 +64,18 @@ __all__ = ["Engine", "default_n_steps"]
 _I32_SUM_GUARD = 2**31 - 1
 
 
+def _host_reduce_sums(out: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+    """Collapse the per-run float32 ratio leaves into float64 host sums —
+    the finalize boundary where ~1e-5 float32 accumulation noise on 8k-run
+    batches is eliminated (see finalize_fn). A dict without per-run leaves
+    (the multi-controller device-psum path) passes through unchanged."""
+    for name in ("blocks_share", "stale_rate"):
+        per_run = out.pop(name + "_per_run", None)
+        if per_run is not None:
+            out[name + "_sum"] = per_run.astype(np.float64).sum(axis=0)
+    return out
+
+
 def default_n_steps(duration_ms: int, block_interval_s: float) -> int:
     """Upper bound on event-loop iterations for one run: found events +
     arrival events <= 2x the block count, sized at mean + 8 sigma of the
@@ -271,11 +283,17 @@ class Engine:
             per_run = jax.vmap(final_stats)(state, t_end)
             return {
                 "blocks_found_sum": jnp.sum(per_run["blocks_found"], axis=0),
-                "blocks_share_sum": jnp.sum(per_run["blocks_share"], axis=0),
-                "stale_rate_sum": jnp.sum(per_run["stale_rate"], axis=0),
                 "stale_blocks_sum": jnp.sum(per_run["stale_blocks"], axis=0),
                 "best_height_sum": jnp.sum(per_run["best_height"]),
                 "overflow_sum": jnp.sum(per_run["overflow"]),
+                # The per-run float32 ratios leave the device unsummed: an
+                # 8192-element float32 device sum put ~1e-5 absolute noise on
+                # the share/stale-rate means (one order under the ±1e-4
+                # cross-validation criterion); _host_reduce_sums sums them in
+                # float64 on the host instead, for ~(R, M) float32 of extra
+                # transfer per batch (~0.3 MB at the default batch size).
+                "blocks_share_per_run": per_run["blocks_share"],
+                "stale_rate_per_run": per_run["stale_rate"],
             }
 
         vinit = jax.vmap(init_fn, in_axes=(0, None))
@@ -310,14 +328,40 @@ class Engine:
                 )
             )
 
+            # Multi-controller runs cannot gather per-run leaves to one host
+            # (non-addressable shards), so they reduce the ratio sums on
+            # device in float32 as psums — the historical behavior. Single-
+            # controller meshes keep the per-run leaves sharded and let the
+            # host do the float64 sum, identical to the no-mesh path.
+            multiproc = jax.process_count() > 1
+            out_specs = {
+                "blocks_found_sum": P(), "stale_blocks_sum": P(),
+                "best_height_sum": P(), "overflow_sum": P(),
+            }
+            if multiproc:
+                out_specs.update(blocks_share_sum=P(), stale_rate_sum=P())
+            else:
+                out_specs.update(
+                    blocks_share_per_run=P("runs"), stale_rate_per_run=P("runs")
+                )
+
             def sharded_finalize(state, t_end):
                 local = finalize_fn(state, t_end)
-                return jax.tree_util.tree_map(lambda x: jax.lax.psum(x, "runs"), local)
+                share = local.pop("blocks_share_per_run")
+                stale = local.pop("stale_rate_per_run")
+                out = jax.tree_util.tree_map(lambda x: jax.lax.psum(x, "runs"), local)
+                if multiproc:
+                    out["blocks_share_sum"] = jax.lax.psum(jnp.sum(share, axis=0), "runs")
+                    out["stale_rate_sum"] = jax.lax.psum(jnp.sum(stale, axis=0), "runs")
+                else:
+                    out["blocks_share_per_run"] = share
+                    out["stale_rate_per_run"] = stale
+                return out
 
             self._finalize = jax.jit(
                 shard_map(
                     sharded_finalize, mesh=mesh,
-                    in_specs=(P("runs"), P("runs")), out_specs=P(),
+                    in_specs=(P("runs"), P("runs")), out_specs=out_specs,
                     check_vma=False,
                 )
             )
@@ -405,7 +449,7 @@ class Engine:
             hi0 = jnp.full((n,), dur >> 30, jnp.int32)
             lo0 = jnp.full((n,), dur & (self._LEDGER_BASE - 1), jnp.int32)
             sums = self._run_device(keys, hi0, lo0, self.params)
-            out = {k: np.asarray(v) for k, v in sums.items()}
+            out = _host_reduce_sums({k: np.asarray(v) for k, v in sums.items()})
             n_chunks = int(out.pop("n_chunks"))
             if out.pop("unfinished"):
                 raise RuntimeError(
@@ -482,6 +526,6 @@ class Engine:
 
         t_end = device_i32(remaining)
         sums = self._finalize(state, t_end)
-        out = {k: np.asarray(v) for k, v in sums.items()}
+        out = _host_reduce_sums({k: np.asarray(v) for k, v in sums.items()})
         out["runs"] = np.int64(n)
         return out

@@ -74,7 +74,10 @@ def make_engine(
         try:
             return PallasEngine(config, mesh, **kw)
         except ValueError:
-            if forced:
+            if forced or kw:
+                # Explicit kernel-tuning overrides exist to sweep the kernel;
+                # silently measuring the scan engine instead would corrupt
+                # every such sweep point, so they are as strict as forcing.
                 raise
             logger.info("config not eligible for the pallas engine; using scan engine")
     return Engine(config, mesh)
