@@ -12,7 +12,9 @@ from __future__ import annotations
 import numpy as np
 import pytest
 
-from tpusim.config import MinerConfig, NetworkConfig, SimConfig, default_network
+from tpusim.config import (
+    MinerConfig, NetworkConfig, SimConfig, default_network, reference_selfish_network,
+)
 from tpusim.engine import Engine
 from tpusim.pallas_engine import PallasEngine
 from tpusim.runner import make_run_keys
@@ -28,9 +30,7 @@ HETERO = NetworkConfig(
 )
 
 
-SELFISH40 = default_network(
-    propagation_ms=1000, selfish_ids=(0,), hashrates=(40, 19, 12, 11, 8, 5, 3, 1, 1)
-)
+SELFISH40 = reference_selfish_network()
 
 
 @pytest.mark.parametrize(
@@ -43,6 +43,11 @@ SELFISH40 = default_network(
         # Non-default K=4 fast: covers the kernel's generic K-slot group
         # machinery, which the K=2 default routes around (the split-slot path).
         (default_network(propagation_ms=10_000), 2 * 86_400_000, 64, "fast", 4),
+        # Non-default K=2 exact: the split-slot specialization in the exact
+        # kernel (incl. the split-slot reveal push), the perf opt-in for
+        # selfish/10s sweeps.
+        (SELFISH40, 4 * 86_400_000, 128, "exact", 2),
+        (default_network(propagation_ms=10_000), 2 * 86_400_000, 64, "exact", 2),
     ],
 )
 def test_pallas_matches_scan_engine_exactly(network, duration_ms, chunk_steps, mode, group_slots):
@@ -76,7 +81,7 @@ def test_pallas_matches_scan_engine_exactly(network, duration_ms, chunk_steps, m
             np.testing.assert_array_equal(a, b, err_msg=name)
 
 
-def test_pallas_refuses_fast_selfish_and_mesh():
+def test_pallas_refuses_fast_selfish_and_multicontroller_mesh(monkeypatch):
     fast_selfish = SimConfig(
         network=SELFISH40,
         runs=128,
@@ -84,9 +89,46 @@ def test_pallas_refuses_fast_selfish_and_mesh():
     )
     with pytest.raises(ValueError):
         PallasEngine(fast_selfish)
+    # Single-controller meshes are supported; multi-controller ones are not
+    # (per-run leaves cannot be gathered across controllers, and the CPU
+    # multi-process path has no TPU kernel to run anyway).
+    import jax
+    from jax.sharding import Mesh
+
     honest = SimConfig(network=default_network(), runs=128)
-    with pytest.raises(ValueError):
-        PallasEngine(honest, mesh=object())
+    mesh = Mesh(np.array(jax.devices()), ("runs",))
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    with pytest.raises(ValueError, match="multi-controller"):
+        PallasEngine(honest, mesh=mesh)
+
+
+def test_pallas_mesh_shards_kernel_and_matches_single_device():
+    """A single-controller mesh runs the kernel per device on its local run
+    shard (the whole device-resident batch loop is shard-mapped); the result
+    must be bit-identical to the single-device scan engine — integer sums via
+    exact int psums, ratio means via the gathered per-run float64 host sum."""
+    import jax
+    from jax.sharding import Mesh
+
+    config = SimConfig(
+        network=SELFISH40,
+        duration_ms=6_000_000,
+        runs=1024,  # 8 devices x one 128-run tile each
+        batch_size=1024,
+        mode="exact",
+        chunk_steps=64,
+        seed=11,
+    )
+    keys = make_run_keys(config.seed, 0, config.runs)
+    mesh = Mesh(np.array(jax.devices()), ("runs",))
+    pallas_mesh = PallasEngine(config, mesh, tile_runs=128, interpret=True)
+    out_mesh = pallas_mesh.run_batch(keys)
+    out_single = Engine(config, None).run_batch(keys)
+    assert out_mesh.keys() == out_single.keys()
+    for name in out_single:
+        np.testing.assert_array_equal(
+            np.asarray(out_mesh[name]), np.asarray(out_single[name]), err_msg=name
+        )
 
 
 def test_pallas_refuses_oversized_vmem_config():

@@ -99,6 +99,17 @@ def default_network(
     return NetworkConfig(miners=miners)
 
 
+def reference_selfish_network() -> NetworkConfig:
+    """The reference's selfish-mining benchmark roster (README.md:89-107,
+    main.cpp:44-65 with miner 0 at 40 % and selfish=true): 40 % gamma=0
+    selfish miner plus eight honest miners, 1 s propagation. The exact-mode
+    production benchmark config shared by bench.py, the hardware sweeps and
+    the kernel-equality tests."""
+    return default_network(
+        propagation_ms=1000, selfish_ids=(0,), hashrates=(40, 19, 12, 11, 8, 5, 3, 1, 1)
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class SimConfig:
     """Full simulation configuration: network + duration + run plan.

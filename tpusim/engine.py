@@ -366,6 +366,45 @@ class Engine:
                 )
             )
 
+            if not multiproc:
+                # Single-controller mesh: the whole device-resident batch
+                # loop runs SPMD — each device drives its own lax.while_loop
+                # over its run shard (no collectives inside, so divergent
+                # chunk counts are fine) and only the final stat sums meet in
+                # a psum. This is what puts the >1-device path on the same
+                # one-dispatch-per-batch footing as the single-device path
+                # (and, for PallasEngine, the kernel on every device).
+                loop_out_specs = {
+                    "blocks_found_sum": P(), "stale_blocks_sum": P(),
+                    "best_height_sum": P(), "overflow_sum": P(),
+                    "blocks_share_per_run": P("runs"),
+                    "stale_rate_per_run": P("runs"),
+                    "n_chunks": P(), "unfinished": P(),
+                }
+
+                def sharded_device_loop(keys, hi0, lo0, params):
+                    sums = self._device_loop(keys, hi0, lo0, params)
+                    out = {}
+                    for name, v in sums.items():
+                        if name.endswith("_per_run"):
+                            out[name] = v
+                        elif name == "n_chunks":
+                            out[name] = jax.lax.pmax(v, "runs")
+                        elif name == "unfinished":
+                            out[name] = jax.lax.pmax(v.astype(jnp.int32), "runs")
+                        else:
+                            out[name] = jax.lax.psum(v, "runs")
+                    return out
+
+                self._run_device = jax.jit(
+                    shard_map(
+                        sharded_device_loop, mesh=mesh,
+                        in_specs=(P("runs"), P("runs"), P("runs"), rep_params),
+                        out_specs=loop_out_specs,
+                        check_vma=False,
+                    )
+                )
+
     def make_keys(self, start: int, count: int) -> jax.Array:
         """The per-run sampling-identity array for global run indices
         [start, start+count) — threefry keys by default, packed xoroshiro
@@ -429,11 +468,12 @@ class Engine:
     def run_batch(self, keys: jax.Array, *, host_loop: bool = False) -> dict[str, np.ndarray]:
         """Simulate one batch of runs to completion; returns stat sums.
 
-        Single-device: one jitted device-resident program per batch
-        (:meth:`_device_loop`). With a mesh (or ``host_loop=True``, kept for
-        the multi-process path and for device/host-loop equivalence tests):
+        Single-device and single-controller meshes: one jitted
+        device-resident program per batch (:meth:`_device_loop`, shard-mapped
+        over the mesh when there is one). Multi-controller meshes (or
+        ``host_loop=True``, kept for device/host-loop equivalence tests):
         jitted chunk -> re-base -> subtract elapsed from the int64 remaining
-        ledger on the host -> repeat until every run finishes. Both paths draw
+        ledger on the host -> repeat until every run finishes. All paths draw
         identically and produce bit-identical sums.
         """
         n = keys.shape[0]
@@ -444,7 +484,10 @@ class Engine:
                 f"batch of {n} runs x {duration} ms overflows int32 block-count "
                 f"sums; lower batch_size below {int(_I32_SUM_GUARD / (blocks_bound / n))}"
             )
-        if self.mesh is None and not host_loop:
+        device_loop_ok = self.mesh is None or (
+            jax.process_count() == 1 and n % self.mesh.devices.size == 0
+        )
+        if device_loop_ok and not host_loop:
             dur = int(duration)
             hi0 = jnp.full((n,), dur >> 30, jnp.int32)
             lo0 = jnp.full((n,), dur & (self._LEDGER_BASE - 1), jnp.int32)

@@ -131,13 +131,15 @@ def _make_kernel(
         # The group buffer has two trace-time implementations with identical
         # semantics (bit-identical state; cross-checked against the scan
         # engine): the generic K-slot one-hot machinery, and a split-slot
-        # specialization for the fast-mode default K=2. The specialization
-        # exists purely for the VPU: an (M, K, R) op tiles its minor (K, R)
-        # dims onto 8x128 vregs, so K=2 uses 2 of 8 sublanes — 75% of the
-        # vector unit idles. Carrying the slots as 2xK (M, R) arrays through
-        # the step loop instead makes every group op fully dense; ablation
-        # timing attributed ~50% of the fast step to exactly these ops.
-        fast2 = not exact and k == 2
+        # specialization for K=2 in either mode. The specialization exists
+        # purely for the VPU: an (M, K, R) op tiles its minor (K, R) dims
+        # onto 8x128 vregs, so K=2 uses 2 of 8 sublanes — 75% of the vector
+        # unit idles. Carrying the slots as 2xK (M, R) arrays through the
+        # step loop instead makes every group op fully dense; ablation
+        # timing attributed ~50% of the fast step to exactly these ops
+        # (exact mode's default K is 4; group_slots=2 opts an exact config
+        # into this path, overflow-merge diagnostics counted as always).
+        split2 = k == 2
 
         def push_groups(garr, gcnt, arrival, count, do):
             """Append an (arrival, count) group per miner where ``do`` is set
@@ -229,7 +231,7 @@ def _make_kernel(
                 push_count = I32(1)
 
             arrival = t + prop  # (M, R)
-            if fast2:
+            if split2:
                 a0, a1 = st["garr"]
                 c0, c1 = st["gcnt"]
                 a0, a1, c0, c1, over = push_groups2(
@@ -246,7 +248,7 @@ def _make_kernel(
             # no-op, and the reveal/adopt masks carry the gate.
             do = active & ~(found_due & (nbt == t))
             t_flush = jnp.where(do, t, neg_gate)  # (1, R)
-            if fast2:
+            if split2:
                 # Split-slot flush: sortedness (a0 <= a1 when both live, INF
                 # in empty slots) makes the arrived set {f0, f0&f1}.
                 f0 = a0 <= t_flush  # (M, R)
@@ -289,7 +291,14 @@ def _make_kernel(
                 sc = npriv
                 can_reveal = selfish & (lead >= 0) & (sc > lead) & do
                 reveal_n = jnp.where((sc > 1) & (lead == 1), sc, sc - lead)
-                garr, gcnt, over = push_groups(garr, gcnt, t + prop, reveal_n, can_reveal)
+                if split2:
+                    a0, a1, c0, c1, over = push_groups2(
+                        a0, a1, c0, c1, t + prop, reveal_n, can_reveal
+                    )
+                else:
+                    garr, gcnt, over = push_groups(
+                        garr, gcnt, t + prop, reveal_n, can_reveal
+                    )
                 ovf = ovf + over
                 npriv = jnp.where(can_reveal, sc - reveal_n, sc)
 
@@ -371,7 +380,7 @@ def _make_kernel(
 
             height = jnp.where(adopt, best_h, height)
             base = jnp.where(adopt, best_tip, base)
-            if fast2:
+            if split2:
                 a0 = jnp.where(adopt, inf, a0)
                 a1 = jnp.where(adopt, inf, a1)
                 c0 = jnp.where(adopt, 0, c0)
@@ -390,7 +399,7 @@ def _make_kernel(
 
             st.update(t=t, nbt=nbt, height=height, stale=stale, base=base,
                       ovf=ovf, ocp=ocp, oin=oin, ocnt=ocnt)
-            if fast2:
+            if split2:
                 st.update(garr=(a0, a1), gcnt=(c0, c1))
             else:
                 st.update(garr=garr, gcnt=gcnt)
@@ -400,12 +409,12 @@ def _make_kernel(
 
         def load(ref, name):
             val = ref[...]
-            if fast2 and name in ("garr", "gcnt"):
+            if split2 and name in ("garr", "gcnt"):
                 return (val[:, 0, :], val[:, 1, :])
             return val
 
         def stored(val, name):
-            if fast2 and name in ("garr", "gcnt"):
+            if split2 and name in ("garr", "gcnt"):
                 # Rebuild the (M, K, R) layout with a K-broadcast select (a
                 # middle-axis concatenate does not lower in Mosaic).
                 return jnp.where(kidx == 0, val[0][:, None, :], val[1][:, None, :])
@@ -423,8 +432,10 @@ class PallasEngine(Engine):
     """Engine with the per-chunk execution replaced by the VMEM-resident
     Pallas kernel. Same host loop, same init/finalize, same draws — the
     outputs are bit-identical to the scan engine on any supported config.
-    Refuses device meshes and fast-mode-with-selfish rosters (those run on
-    the scan engine).
+    Single-controller device meshes shard the batch's runs axis and run the
+    kernel on every device (run-level parallelism of reference
+    main.cpp:195-220 at kernel speed); multi-controller meshes and
+    fast-mode-with-selfish rosters stay on the scan engine.
 
     ``tile_runs`` lanes of independent runs per grid cell (multiple of 128);
     ``step_block`` scan steps per kernel invocation — state stays in VMEM
@@ -441,8 +452,11 @@ class PallasEngine(Engine):
         interpret: bool = False,
         vmem_guard: bool = True,
     ):
-        if mesh is not None:
-            raise ValueError("PallasEngine is single-device; shard batches at the runner level")
+        if mesh is not None and jax.process_count() > 1:
+            raise ValueError(
+                "PallasEngine shards batches over single-controller meshes "
+                "only; multi-controller runs use the scan engine"
+            )
         if config.network.any_selfish and config.resolved_mode != "exact":
             raise ValueError(
                 "PallasEngine needs exact mode for selfish rosters (fast-mode "
@@ -490,7 +504,7 @@ class PallasEngine(Engine):
                 f"the 16 MB scoped limit ({m} miners, {'exact' if exact else 'fast'} "
                 f"mode, tile_runs={tile_runs}); use the scan engine"
             )
-        super().__init__(config, None)
+        super().__init__(config, mesh)
         # The kernel consumes whole step blocks. The scan engine's auto
         # sizing is 64-aligned on every platform; silently changing an
         # explicitly requested chunk_steps would fork the sampling identity
@@ -518,8 +532,25 @@ class PallasEngine(Engine):
         )
         # Replace the scan chunk in BOTH batch paths: _chunk drives the
         # host-loop path, _chunk_impl is what _device_loop (jitted lazily, so
-        # this assignment lands before the first trace) closes over.
-        self._chunk = jax.jit(self._pallas_chunk)
+        # this assignment lands before the first trace) closes over — with a
+        # mesh, the shard-mapped device loop then runs the kernel on every
+        # device against its local run shard (pallas_call operands inside
+        # shard_map are the per-device shards).
+        if mesh is None:
+            self._chunk = jax.jit(self._pallas_chunk)
+        else:
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            rep_params = jax.tree_util.tree_map(lambda _: P(), self.params)
+            self._chunk = jax.jit(
+                shard_map(
+                    self._pallas_chunk, mesh=mesh,
+                    in_specs=(P("runs"), P("runs"), P("runs"), P("runs"), P(), rep_params),
+                    out_specs=(P("runs"), P("runs"), P("runs")),
+                    check_vma=False,
+                )
+            )
         self._chunk_impl = self._pallas_chunk
         self._scan_fallback: Engine | None = None
 
@@ -537,16 +568,20 @@ class PallasEngine(Engine):
 
     def run_batch(self, keys, *, host_loop: bool = False):
         """Tile-misaligned batches split: the aligned prefix runs on the
-        kernel, the remainder on the draw-identical scan twin."""
+        kernel, the remainder on the draw-identical scan twin. With a mesh
+        the alignment unit is ``tile_runs`` per device (every device's shard
+        must be whole tiles)."""
         n = keys.shape[0]
-        rem = n % self.tile_runs
+        unit = self.tile_runs * (1 if self.mesh is None else self.mesh.devices.size)
+        rem = n % unit
         if rem == 0:
             return super().run_batch(keys, host_loop=host_loop)
         logger.info(
-            "batch of %d is not a multiple of tile_runs=%d; %d run(s) take the scan engine",
-            n, self.tile_runs, rem,
+            "batch of %d is not a multiple of %d (tile_runs x devices); "
+            "%d run(s) take the scan engine",
+            n, unit, rem,
         )
-        if n < self.tile_runs:
+        if n < unit:
             return self.scan_twin().run_batch(keys, host_loop=host_loop)
         head = super().run_batch(keys[: n - rem], host_loop=host_loop)
         tail = self.scan_twin().run_batch(keys[n - rem:], host_loop=host_loop)

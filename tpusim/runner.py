@@ -45,13 +45,13 @@ def make_engine(
     step_block: int | None = None,
 ):
     """Pick the fastest engine for the platform: the Pallas VMEM kernel
-    (tpusim.pallas_engine) on a single TPU device — fast mode for honest
-    rosters, exact mode including the selfish machinery — and the scan
-    engine otherwise (CPU, device meshes, or a fast-mode-selfish config,
-    which raises inside PallasEngine and falls through). The two are
-    draw-for-draw identical; callers that hit a runtime failure in the
-    Pallas path can rebuild a scan engine pinned to the same chunk_steps
-    and lose nothing.
+    (tpusim.pallas_engine) on TPU — fast mode for honest rosters, exact mode
+    including the selfish machinery, batch-sharded over single-controller
+    device meshes — and the scan engine otherwise (CPU, multi-controller
+    meshes, or a fast-mode-selfish config, which raises inside PallasEngine
+    and falls through). The two are draw-for-draw identical; callers that
+    hit a runtime failure in the Pallas path can rebuild a scan engine
+    pinned to the same chunk_steps and lose nothing.
 
     ``prefer_pallas=True`` is a *forced* choice: an ineligible config
     (mesh, fast-mode selfish, xoroshiro rng, VMEM-guard refusal) raises its
@@ -62,7 +62,17 @@ def make_engine(
     defaults for on-hardware sweeps (ignored by the scan engine)."""
     forced = prefer_pallas is True
     if prefer_pallas is None:
-        prefer_pallas = mesh is None and jax.devices()[0].platform == "tpu"
+        prefer_pallas = (
+            jax.devices()[0].platform == "tpu" and jax.process_count() == 1
+        )
+        if not prefer_pallas and (tile_runs is not None or step_block is not None):
+            # Same strictness as below: a tuning override that silently
+            # measured the scan engine would corrupt the sweep it exists for.
+            raise ValueError(
+                "tile_runs/step_block tune the pallas kernel, but this "
+                "platform auto-routes to the scan engine; pass "
+                "prefer_pallas/engine='pallas' explicitly or drop the overrides"
+            )
     if prefer_pallas:
         from .pallas_engine import PallasEngine
 
@@ -138,15 +148,15 @@ def run_simulation_config(
     Equivalent of the reference's ``main()`` (main.cpp:195-235) minus printing.
     Runs are processed in batches of ``config.batch_size``; when more than one
     device is visible (and no explicit mesh is given) the runs axis of each
-    batch is sharded across all devices. ``engine`` forces the execution
-    engine: "pallas" (single-device; skips the multi-device mesh, raises on
-    an ineligible config, and falls back to the draw-identical scan twin
-    only on a runtime kernel failure), "scan", or "auto" (the platform
-    default of :func:`make_engine`).
+    batch is sharded across all devices — the Pallas kernel included, which
+    then runs per device on its local shard. ``engine`` forces the execution
+    engine: "pallas" (raises on an ineligible config, falls back to the
+    draw-identical scan twin only on a runtime kernel failure), "scan", or
+    "auto" (the platform default of :func:`make_engine`).
     """
     if engine not in ("auto", "pallas", "scan"):
         raise ValueError(f"unknown engine {engine!r}; use auto, pallas or scan")
-    if mesh is None and use_all_devices and engine != "pallas" and len(jax.devices()) > 1:
+    if mesh is None and use_all_devices and len(jax.devices()) > 1:
         mesh = Mesh(np.array(jax.devices()), ("runs",))
 
     n_dev = 1 if mesh is None else mesh.devices.size
