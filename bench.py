@@ -31,6 +31,8 @@ import time
 
 CPU_CORE_BASELINE_SIM_YEARS_PER_S = 86.0
 YEAR_MS = 365.2425 * 86_400_000.0
+PERF_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "artifacts", "perf_tpu.jsonl")
 
 
 def log(msg: str) -> None:
@@ -39,6 +41,47 @@ def log(msg: str) -> None:
 
 def emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
+
+
+def cached_tpu_numbers(path: str = PERF_LOG) -> dict | None:
+    """Last builder-measured on-chip throughput rows from the perf log, per
+    mode — emitted whenever this bench run falls back to CPU, so a wedged
+    tunnel can never erase the on-hardware perf story from the round
+    artifact (the CPU number alone reads as a 0.2x regression)."""
+    fast = exact = None
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "TPU" not in str(row.get("chip", "")):
+                    continue
+                rate = row.get("sim_years_per_s")
+                if not isinstance(rate, (int, float)):
+                    continue
+                keep = {
+                    k: row[k]
+                    for k in ("date", "chip", "engine", "mode", "config",
+                              "sim_years_per_s", "vs_cpu_core_baseline",
+                              "measurement", "note")
+                    if k in row
+                }
+                if "exact" in str(row.get("mode", "")):
+                    exact = keep
+                else:
+                    fast = keep
+    except OSError:
+        return None
+    if fast is None and exact is None:
+        return None
+    return {
+        "fast": fast,
+        "exact": exact,
+        "note": "last builder-measured on-chip values (artifacts/perf_tpu.jsonl); "
+                "this bench run could not reach the TPU",
+    }
 
 
 
@@ -58,13 +101,20 @@ def main() -> int:
     ap.add_argument("--hard-timeout", type=float, default=1500.0,
                     help="watchdog for the whole benchmark, seconds")
     ap.add_argument("--skip-smoke", action="store_true")
+    ap.add_argument("--exact-target-seconds", type=float, default=20.0,
+                    help="measurement budget for the exact-mode (selfish) "
+                         "headline; 0 skips it")
+    ap.add_argument("--ablate", type=int, default=0, metavar="N_CHUNKS",
+                    help="instead of the headline, time N>=12 chained chunks "
+                         "inside one jit per engine (the canonical "
+                         "kernel-timing discipline) and emit us/step")
     args = ap.parse_args()
 
     phase = "backend-init"
     info: dict = {}
 
     def fail(err: Exception | str) -> int:
-        emit({
+        payload = {
             "metric": "sim_years_per_sec_per_chip (FAILED)",
             "value": 0.0,
             "unit": "sim-years/s/chip",
@@ -72,7 +122,14 @@ def main() -> int:
             "error": str(err)[:500],
             "phase": phase,
             **info,
-        })
+        }
+        # Only when the TPU was genuinely unreached: a failure ON the chip
+        # must not be dressed up as a tunnel outage with stale cached rows.
+        if info.get("platform") != "tpu":
+            cached = cached_tpu_numbers()
+            if cached is not None:
+                payload["cached_tpu"] = cached
+        emit(payload)
         return 1
 
     def on_alarm(signum, frame):
@@ -116,6 +173,51 @@ def main() -> int:
             return make_engine(config)
 
         years_per_run = DEFAULT_DURATION_MS / YEAR_MS
+
+        from tpusim.config import reference_selfish_network
+
+        SELFISH_NET = reference_selfish_network()
+
+        # --- Mode: chained-chunk ablation (not the headline). Times >= 12
+        # chunk programs inside ONE jit per engine/mode — the canonical
+        # kernel-timing discipline (single-chunk timings over the tunnel
+        # vary +-40 %; see tpusim.profiling.time_chained_chunks).
+        if args.ablate:
+            phase = "ablate"
+            from tpusim.profiling import time_chained_chunks
+
+            n_chunks = max(12, args.ablate)
+            runs_ab = 8192 if platform == "tpu" else 128
+            csteps = None if platform == "tpu" else 256
+            results: dict[str, dict] = {}
+            for mode_name, net in (("fast", default_network(propagation_ms=1000)),
+                                   ("exact", SELFISH_NET)):
+                cfg = SimConfig(network=net, duration_ms=DEFAULT_DURATION_MS,
+                                runs=runs_ab, batch_size=runs_ab, seed=7,
+                                chunk_steps=csteps)
+                engines = [Engine(cfg)]
+                if platform == "tpu" and args.engine != "scan":
+                    try:
+                        engines.insert(0, PallasEngine(cfg))
+                    except ValueError as e:
+                        log(f"ablate: no pallas engine for {mode_name}: {e}")
+                for eng_ab in engines:
+                    tag = f"{mode_name}/{type(eng_ab).__name__}"
+                    results[tag] = time_chained_chunks(
+                        eng_ab, make_run_keys(7, 0, runs_ab), n_chunks
+                    )
+                    log(f"ablate {tag}: {results[tag]}")
+            signal.alarm(0)
+            first = next(iter(results.values()))
+            emit({
+                "metric": f"us_per_step (chained-chunk ablation, {platform})",
+                "value": first["us_per_step"],
+                "unit": "us/step",
+                "vs_baseline": 0.0,
+                "ablation": results,
+                **info,
+            })
+            return 0
 
         # --- Phase: smoke — prove the full engine path at small scale and
         # calibrate the headline batch so warm-up cannot eat the budget.
@@ -210,10 +312,57 @@ def main() -> int:
             if time.perf_counter() - t0 >= args.target_seconds:
                 break
         elapsed = time.perf_counter() - t0
-        signal.alarm(0)
-
         sim_years_per_s = total_runs * years_per_run / elapsed
-        emit({
+
+        # --- Phase: exact-mode headline. Every selfish and >=10s-propagation
+        # production sweep resolves to exact mode, so the headline fast-mode
+        # number alone cannot show regressions where the science lives. The
+        # config is the reference's selfish benchmark (README.md:89-107):
+        # 40 % selfish miner 0, gamma=0, 1 s propagation.
+        if args.exact_target_seconds > 0:
+            phase = "exact-headline"
+            ebatch = 2048 if platform == "tpu" else 8
+            exact_cfg = SimConfig(
+                network=SELFISH_NET, duration_ms=DEFAULT_DURATION_MS,
+                runs=ebatch, batch_size=ebatch, seed=7,
+            )
+            eng2 = build_engine(exact_cfg)
+            einfo: dict = {
+                "engine": "pallas" if isinstance(eng2, PallasEngine) else "scan",
+                "batch_size": ebatch,
+                "mode": exact_cfg.resolved_mode,
+            }
+            t0 = time.monotonic()
+            try:
+                eng2.run_batch(make_run_keys(7, 0, ebatch))
+            except Exception as e:
+                if not hasattr(eng2, "scan_twin"):
+                    raise
+                log(f"exact pallas engine failed ({e!r}); falling back to scan twin")
+                eng2 = eng2.scan_twin()
+                einfo["engine"] = "scan (pallas fallback)"
+                eng2.run_batch(make_run_keys(7, 0, ebatch))
+            einfo["warmup_s"] = round(time.monotonic() - t0, 2)
+            total2 = 0
+            t0 = time.perf_counter()
+            for i in range(args.max_batches):
+                eng2.run_batch(make_run_keys(7, (i + 1) * ebatch, ebatch))
+                total2 += ebatch
+                if time.perf_counter() - t0 >= args.exact_target_seconds:
+                    break
+            e_elapsed = time.perf_counter() - t0
+            e_rate = total2 * years_per_run / e_elapsed
+            einfo.update(
+                runs=total2,
+                elapsed_s=round(e_elapsed, 2),
+                sim_years_per_s=round(e_rate, 3),
+                vs_baseline=round(e_rate / CPU_CORE_BASELINE_SIM_YEARS_PER_S, 3),
+            )
+            info["exact"] = einfo
+            log(f"exact headline: {einfo}")
+
+        signal.alarm(0)
+        payload = {
             "metric": (
                 f"sim_years_per_sec_per_chip ({platform}/{info['engine']}, "
                 f"{total_runs} runs x 365d, 9-miner honest)"
@@ -223,7 +372,12 @@ def main() -> int:
             "vs_baseline": round(sim_years_per_s / CPU_CORE_BASELINE_SIM_YEARS_PER_S, 3),
             "elapsed_s": round(elapsed, 2),
             **info,
-        })
+        }
+        if platform != "tpu":
+            cached = cached_tpu_numbers()
+            if cached is not None:
+                payload["cached_tpu"] = cached
+        emit(payload)
         return 0
     except BaseException as e:  # noqa: BLE001 — the JSON line must always appear
         if isinstance(e, (KeyboardInterrupt, SystemExit)):

@@ -1,0 +1,183 @@
+"""Mosaic layout micro-benchmarks for the exact-mode flattening decision.
+
+The exact kernel's dominant ops act on (M, M, M, R) / (M, M, R) arrays whose
+minor (M, R) = (9, 256) tiles idle 7 of 16 padded sublanes (56 % dense).
+Flattening the leading dims to rows — (729, R) / (81, R), 92-99 % dense —
+would reclaim that, IF Mosaic can cheaply (a) reshape between the forms or
+(b) expand (M, R) masks/values to flat rows. Nobody knows the relayout cost
+without running it; this script measures exactly that, per op, on hardware:
+
+  1. sel3     — status-quo 3-level where on (9,9,9,R), (M,M,R)-broadcast conds
+  2. sel_flat — same select count on (729,R) with PRE-BUILT flat masks
+                (upper bound on the flattening gain)
+  3. reshape  — (9,9,R) <-> (81,R) round-trip through jnp.reshape in-kernel
+  4. repeat   — (9,R) -> (81,R) block-repeat (rows i*9+j <- src row i)
+  5. tile     — (9,R) -> (81,R) tile (rows i*9+j <- src row j)
+  6. segsum   — (81,R) -> (9,R) 9-row segmented sum via reshape+sum
+  7. contract — status-quo cpb extraction: sum over leading axis of
+                (9,9,9,R) * (9,1,1,R)
+
+Each variant runs ``--iters`` iterations inside ONE pallas_call fori_loop
+(the chained discipline; dispatch amortized), min of 3 repeats. A variant
+that fails to lower prints LOWER-FAIL with the Mosaic error — that is a
+result, not a bug. Appends rows to artifacts/mosaic_micro_r5.jsonl.
+
+Decision rule (BASELINE/VERDICT round-5 plan): flatten only if
+sel_flat + needed expansions/reshapes beats sel3 by enough to matter —
+otherwise record the measured write-up and stop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--r", type=int, default=256, help="lanes (exact-mode tile width)")
+    ap.add_argument("--iters", type=int, default=512, help="op iterations per kernel call")
+    ap.add_argument("--allow-cpu", action="store_true",
+                    help="run in interpret mode off-TPU (timing meaningless; "
+                         "checks the harness itself)")
+    ap.add_argument("--out", type=Path,
+                    default=Path(__file__).resolve().parent.parent
+                    / "artifacts" / "mosaic_micro_r5.jsonl")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    dev = jax.devices()[0]
+    interpret = dev.platform != "tpu"
+    if interpret and not args.allow_cpu:
+        print("not on TPU (pass --allow-cpu for an interpret-mode harness check)",
+              file=sys.stderr)
+        return 1
+    print("platform:", dev, "interpret:", interpret)
+
+    M, R, N = 9, args.r, args.iters
+    I32 = jnp.int32
+
+    def bench(name, shapes, body):
+        """Time N iterations of ``body(*arrays) -> array`` chained inside one
+        kernel; the iteration result feeds the next via addition so nothing
+        can be dead-code-eliminated."""
+        def kernel(*refs):
+            *ins, out = refs
+            vals = [r[...] for r in ins]
+
+            def it(i, acc):
+                r = body(*vals, acc)
+                return r
+
+            acc = jax.lax.fori_loop(0, N, it, jnp.zeros_like(out[...]))
+            out[...] = acc
+
+        rng = np.random.default_rng(0)
+        in_shapes = shapes[:-1]  # last shape is the output/accumulator
+        arrays = [jnp.asarray(rng.integers(0, 3, size=s, dtype=np.int32)) for s in in_shapes]
+        out_shape = jax.ShapeDtypeStruct(shapes[-1], I32)
+        call = pl.pallas_call(
+            kernel,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM) for _ in in_shapes],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            out_shape=out_shape,
+            interpret=interpret,
+        )
+        fn = jax.jit(lambda *a: call(*a))
+        try:
+            fn(*arrays).block_until_ready()
+        except Exception as e:  # noqa: BLE001 — lowering failure IS the datum
+            msg = str(e).splitlines()[-1][:300] if str(e) else type(e).__name__
+            print(f"[{name}] LOWER-FAIL: {msg}", flush=True)
+            return {"name": name, "lower_fail": msg}
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            fn(*arrays).block_until_ready()
+            times.append(time.perf_counter() - t0)
+        best = min(times)
+        row = {"name": name, "us_per_iter": round(best / N * 1e6, 3),
+               "repeats_s": [round(t, 5) for t in times]}
+        print(f"[{name}] {row['us_per_iter']} us/iter", flush=True)
+        return row
+
+    # Shared operand shapes. `acc` is always the last shape (the output).
+    rows = [{"date": time.strftime("%Y-%m-%d"), "chip": str(dev), "r": R, "iters": N}]
+
+    # 1. Status-quo 3-level select on the cp tensor. conds are (M,M,1,R)
+    #    broadcasts (built from (M,M,R) data), values broadcast per level.
+    def sel3(cp, c1, c2, val, acc):
+        x = jnp.where((c1 + acc[:1, :1, :1, :]) > 1, val[None, None, :, :],
+                      jnp.where(c2 > 1, cp, acc))
+        return x + cp
+
+    rows.append(bench("sel3_status_quo",
+                      [(M, M, M, R), (M, M, 1, R), (M, M, 1, R), (M, R), (M, M, M, R)],
+                      sel3))
+
+    # 2. Same select count, flat rows, pre-built flat masks (upper bound).
+    def sel_flat(cp, c1, c2, val, acc):
+        x = jnp.where((c1 + acc[:1, :]) > 1, val, jnp.where(c2 > 1, cp, acc))
+        return x + cp
+
+    rows.append(bench("sel_flat_prebuilt",
+                      [(M * M * M, R), (M * M * M, R), (M * M * M, R),
+                       (M * M * M, R), (M * M * M, R)],
+                      sel_flat))
+
+    # 3. Reshape round-trip (the open Mosaic question).
+    def reshape_rt(x, acc):
+        flat = jnp.reshape(x + acc, (M * M, R))
+        return jnp.reshape(flat + 1, (M, M, R))
+
+    rows.append(bench("reshape_roundtrip_9x9", [(M, M, R), (M, M, R)], reshape_rt))
+
+    # 4./5. Mask expansions (9,R) -> (81,R).
+    def repeat_rows(src, acc):
+        # rows i*9+j <- src[i]: broadcast middle then collapse.
+        return jnp.reshape(
+            jnp.broadcast_to((src + acc[:M, :])[:, None, :], (M, M, R)), (M * M, R)
+        )
+
+    rows.append(bench("expand_repeat", [(M, R), (M * M, R)], repeat_rows))
+
+    def tile_rows(src, acc):
+        return jnp.reshape(
+            jnp.broadcast_to((src + acc[:M, :])[None, :, :], (M, M, R)), (M * M, R)
+        )
+
+    rows.append(bench("expand_tile", [(M, R), (M * M, R)], tile_rows))
+
+    # 6. Segmented 9-row sum (81,R) -> (9,R) via reshape.
+    def segsum(x, acc):
+        return jnp.sum(jnp.reshape(x + acc[:1, :], (M, M, R)), axis=1)
+
+    rows.append(bench("segsum_reshape", [(M * M, R), (M, R)], segsum))
+
+    # 7. Status-quo cpb contraction: sum over leading axis with a one-hot.
+    def contract(cp, b, acc):
+        return jnp.sum(cp * b, axis=0) + acc  # b is (M, 1, 1, R)
+
+    rows.append(bench("contract_cpb", [(M, M, M, R), (M, 1, 1, R), (M, M, R)], contract))
+
+    args.out.parent.mkdir(parents=True, exist_ok=True)
+    with open(args.out, "a") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

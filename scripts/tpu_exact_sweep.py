@@ -1,0 +1,132 @@
+"""On-TPU exact-mode engine sweep (round-5 measurement plan).
+
+Times, with the chained-chunk discipline (tpusim.profiling.time_chained_chunks,
+>= 12 chunk programs inside one jit, min of 3 repeats), every candidate
+configuration of the exact-mode execution stack on the two configs production
+sweeps actually run — the reference's 40 % selfish benchmark and the honest
+10 s-propagation roster (README.md:51-107) — plus a fast-mode status-quo
+control:
+
+  * pallas vs scan (the r4 open question: a 4-miner smoke hinted exact pallas
+    may be 0.78x scan after the lazy-diagonal rewrite; this decides
+    make_engine's exact routing from data)
+  * group_slots 4 (exact default) vs 2 (the split-slot kernel specialization,
+    which bought the fast path 1.58x)
+  * tile_runs 256 (VMEM-guard limit) vs 512 with the guard bypassed (the
+    lazy-diagonal rewrite shrank contraction temporaries; only the real
+    compiler can say whether 512 now fits)
+  * step_block 32 / 64 / 128
+
+Appends one JSON row per point to artifacts/exact_sweep_r5.jsonl and prints a
+ranked summary. Run it the moment the tunnel is back:
+
+    python scripts/tpu_exact_sweep.py [--runs 2048] [--n-chunks 12]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--runs", type=int, default=2048)
+    ap.add_argument("--n-chunks", type=int, default=12)
+    ap.add_argument("--out", type=Path,
+                    default=Path(__file__).resolve().parent.parent
+                    / "artifacts" / "exact_sweep_r5.jsonl")
+    ap.add_argument("--skip-fast-control", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    dev = jax.devices()[0]
+    print("platform:", dev)
+    if dev.platform != "tpu":
+        print("refusing to sweep off-TPU: these numbers gate engine routing",
+              file=sys.stderr)
+        return 1
+
+    from tpusim.config import SimConfig, default_network, reference_selfish_network
+    from tpusim.engine import Engine
+    from tpusim.pallas_engine import PallasEngine
+    from tpusim.profiling import time_chained_chunks
+    from tpusim.runner import make_run_keys
+
+    SELFISH40 = reference_selfish_network()
+    HONEST10S = default_network(propagation_ms=10_000)
+
+    points: list[dict] = []
+    for cfg_name, net in (("selfish40", SELFISH40), ("honest10s", HONEST10S)):
+        for k in (4, 2):
+            points.append(dict(cfg=cfg_name, net=net, mode="exact", k=k, engine="scan"))
+            for tile, guard in ((256, True), (512, False)):
+                sbs = (32, 64, 128) if tile == 256 else (64,)
+                for sb in sbs:
+                    points.append(dict(cfg=cfg_name, net=net, mode="exact", k=k,
+                                       engine="pallas", tile=tile, sb=sb, guard=guard))
+    if not args.skip_fast_control:
+        points.append(dict(cfg="honest1s", net=default_network(propagation_ms=1000),
+                           mode="fast", k=2, engine="pallas", tile=512, sb=64, guard=True))
+
+    # Rows append to the JSONL as they are measured: this sweep runs in
+    # scarce tunnel-up windows, and a mid-sweep tunnel drop (or an OOM-kill
+    # from a guard-bypassed tiling) must not discard finished points.
+    args.out.parent.mkdir(parents=True, exist_ok=True)
+
+    def record(row: dict) -> None:
+        rows.append(row)
+        with open(args.out, "a") as f:
+            f.write(json.dumps(row) + "\n")
+
+    keys = None
+    rows = []
+    for p in points:
+        cfg = SimConfig(network=p["net"], duration_ms=12 * 2_629_746 * 1000,
+                        runs=args.runs, batch_size=args.runs, seed=7,
+                        mode=p["mode"], group_slots=p["k"])
+        label = (f"{p['cfg']}/{p['engine']}/K{p['k']}"
+                 + (f"/t{p['tile']}x{p['sb']}" if p["engine"] == "pallas" else ""))
+        try:
+            if p["engine"] == "pallas":
+                eng = PallasEngine(cfg, tile_runs=p["tile"], step_block=p["sb"],
+                                   vmem_guard=p["guard"])
+            else:
+                eng = Engine(cfg)
+            if keys is None or keys.shape[0] != args.runs:
+                keys = make_run_keys(7, 0, args.runs)
+            t0 = time.time()
+            r = time_chained_chunks(eng, keys, n_chunks=args.n_chunks)
+        except Exception as e:  # noqa: BLE001 — a failing point must not kill the sweep
+            print(f"[{label}] FAILED: {type(e).__name__}: {str(e)[:300]}", flush=True)
+            record({"date": time.strftime("%Y-%m-%d"), "chip": str(dev),
+                    "label": label, "error": str(e)[:300]})
+            continue
+        # us/step at R runs -> sim-years/s estimate: one batch-step advances
+        # all R runs by ~interval/2.05 s of sim time (chunk sizing, engine.py:
+        # ~2.05 events per block).
+        interval_s = cfg.network.block_interval_s
+        sim_years_per_s = (
+            args.runs * (interval_s / 2.05) / (r["us_per_step"] * 1e-6)
+        ) / (365.2425 * 86_400)
+        row = {"date": time.strftime("%Y-%m-%d"), "chip": str(dev), "label": label,
+               "wall_s": round(time.time() - t0, 1),
+               "est_sim_years_per_s": round(sim_years_per_s, 1), **r}
+        print(f"[{label}] {r['us_per_step']} us/step, spread {r['spread_pct']}%, "
+              f"~{row['est_sim_years_per_s']} sim-years/s", flush=True)
+        record(row)
+
+    ok = [r for r in rows if "us_per_step" in r]
+    for r in sorted(ok, key=lambda r: r["us_per_step"]):
+        print(f"{r['us_per_step']:>10.3f} us/step  {r['label']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
