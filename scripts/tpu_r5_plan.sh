@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Round-5 hardware measurement playbook. Run the moment the tunnel is up:
+#
+#   bash scripts/tpu_r5_plan.sh [logdir]
+#
+# Ordered so the highest-value measurements land first if the tunnel dies
+# mid-run (it has, twice):
+#   1. bench --ablate         — exact pallas-vs-scan routing data (VERDICT #1)
+#   2. mosaic_micro           — the (M,M,M,R)->(729,R) flattening decision
+#   3. tpu_exact_sweep        — engine x K x tile x step_block grid
+#   4. bench (headline)       — driver-format JSON, both modes
+#   5. refscale default1s     — float64-finalize share-diff evidence
+#   6. full-scale grid point  — selfish-hashrate configs[2] at 2^20 runs,
+#                               checkpointed (resumable across windows)
+# Each step logs to $logdir and failures do not stop later steps.
+set -u
+LOG="${1:-artifacts/r5_tpu_logs}"
+mkdir -p "$LOG"
+cd "$(dirname "$0")/.."
+
+run_step() {
+  local name="$1"; shift
+  echo "=== [$(date -u +%H:%M:%S)] $name: $*" | tee -a "$LOG/plan.log"
+  if "$@" >"$LOG/$name.out" 2>"$LOG/$name.err"; then
+    echo "=== $name OK" | tee -a "$LOG/plan.log"
+  else
+    echo "=== $name FAILED rc=$? (continuing)" | tee -a "$LOG/plan.log"
+  fi
+}
+
+run_step ablate      python bench.py --ablate 12 --skip-smoke --probe-retries 1 \
+                       --hard-timeout 1200
+run_step micro       python scripts/mosaic_micro.py --iters 512
+run_step exactsweep  python scripts/tpu_exact_sweep.py --runs 2048 --n-chunks 12
+run_step bench       python bench.py --target-seconds 30 --exact-target-seconds 20 \
+                       --probe-retries 1
+run_step refscale    python scripts/refscale.py --backend tpu --config default1s
+run_step gridpoint   python -m tpusim.sweep selfish-hashrate --runs-scale 1.0 \
+                       --max-points 2 \
+                       --out artifacts/sweep_selfish_hashrate_full_r5.jsonl \
+                       --checkpoint-dir artifacts/ck_sh_full --quiet
+echo "=== plan complete; see $LOG" | tee -a "$LOG/plan.log"

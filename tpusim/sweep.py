@@ -176,6 +176,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--list", action="store_true", help="list sweeps and their points")
     p.add_argument("--backend", default="tpu", choices=("tpu", "cpp"))
     p.add_argument("--runs-scale", type=float, default=1.0)
+    p.add_argument(
+        "--max-points", type=int, default=None,
+        help="run only the first N points of the grid (full-scale runs in "
+        "bounded hardware windows; the rest resume via --checkpoint-dir)",
+    )
     p.add_argument("--out", type=Path, help="append one JSON line per point here")
     p.add_argument("--checkpoint-dir", type=Path, help="per-point npz checkpoints (tpu backend)")
     p.add_argument("--quiet", action="store_true")
@@ -218,8 +223,11 @@ def main(argv: list[str] | None = None) -> int:
                 file=sys.stderr,
             )
 
+    points = sweeps[args.sweep]()
+    if args.max_points is not None:
+        points = points[: args.max_points]
     run_sweep(
-        sweeps[args.sweep](),
+        points,
         backend=args.backend,
         runs_scale=args.runs_scale,
         out_path=args.out,
