@@ -1,0 +1,24 @@
+#!/bin/bash
+# TPU tunnel watcher: probes the backend every ~7 min (SIGKILL-backed
+# timeout — the wedged tunnel ignores SIGTERM in C land) and, on the first
+# UP, runs the round's measurement playbook exactly once.
+#
+#   setsid nohup bash scripts/tpu_watch.sh >/dev/null 2>&1 &
+#
+# Log: /tmp/tpu_watch.log. One-shot latch: /tmp/r5_plan_started.
+cd "$(dirname "$0")/.."
+while true; do
+  if timeout -k 5 150 python -c "import jax; assert jax.devices()[0].platform=='tpu'" >/dev/null 2>&1; then
+    echo "$(date -u +%FT%TZ) TPU UP" >> /tmp/tpu_watch.log
+    if [ ! -f /tmp/r5_plan_started ]; then
+      touch /tmp/r5_plan_started
+      echo "$(date -u +%FT%TZ) launching r5 plan" >> /tmp/tpu_watch.log
+      bash scripts/tpu_r5_plan.sh artifacts/r5_tpu_logs >> /tmp/tpu_watch.log 2>&1
+      echo "$(date -u +%FT%TZ) r5 plan finished; watcher exiting" >> /tmp/tpu_watch.log
+      exit 0
+    fi
+  else
+    echo "$(date -u +%FT%TZ) tpu down" >> /tmp/tpu_watch.log
+  fi
+  sleep 420
+done

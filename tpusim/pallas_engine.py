@@ -333,37 +333,32 @@ def _make_kernel(
                 # correction (tpusim.state.notify).
                 cpb = jnp.sum(cp * b32[:, None, None, :], axis=0)  # (M, M, R)
                 cpb_diag = jnp.sum(jnp.where(eye3, cpb, 0), axis=1)  # (M, R) cp[b, i, i]
-                a_i = adopt[:, None, :]
-                a_j = adopt[None, :, :]
-                is_b_i = onehot_b[:, None, :]
-                is_b_j = onehot_b[None, :, :]
-                cond_pub = (a_i & (a_j | is_b_j)) | (is_b_i & a_j)  # (M, M, R)
-                cond_bj = a_i & ~a_j & ~is_b_j
-                cond_bi = ~a_i & ~is_b_i & a_j
+                # Factored closed-form update (tpusim.state.notify — entry-
+                # for-entry equal to the historical 3-level case analysis):
+                #   Y[j] = (a_j | b_j) ? b_pub : cpb[j]
+                #   W[i] = b_i ? b_pub : cpb[i]
+                #   cp[i,j] = a_i ? Y[j] : (a_j ? W[i] : cp[i,j])
+                # Two selects over the (M, M, M, R) tensor instead of three,
+                # and no composed cond masks.
+                ab = adopt | onehot_b  # (M, R)
+                y_val = jnp.where(ab[:, None, :], row_bpub[None, :, :], cpb)  # (M, M, R)
+                w_val = jnp.where(onehot_b[:, None, :], row_bpub[None, :, :], cpb)
                 cp = jnp.where(
-                    cond_pub[:, :, None, :],
-                    row_bpub[None, None, :, :],
-                    jnp.where(
-                        cond_bj[:, :, None, :],
-                        cpb[None, :, :, :],
-                        jnp.where(cond_bi[:, :, None, :], cpb[:, None, :, :], cp),
-                    ),
+                    adopt[:, None, None, :],
+                    y_val[None, :, :, :],
+                    jnp.where(adopt[None, :, None, :], w_val[:, None, :, :], cp),
                 )
                 # own_cp from the o == i slices of the same update, written
-                # in its transposed [j, i] orientation: cond_bj's value
-                # cp[b, j, i] is cpb read as (j, i) — no transpose needed
-                # (the whole point of the transposed storage).
-                aT_i = adopt[None, :, :]
-                aT_j = adopt[:, None, :]
-                bT_i = onehot_b[None, :, :]
-                bT_j = onehot_b[:, None, :]
-                condT_pub = (aT_i & (aT_j | bT_j)) | (bT_i & aT_j)
-                condT_bj = aT_i & ~aT_j & ~bT_j
-                condT_bi = ~aT_i & ~bT_i & aT_j
+                # in its transposed [j, i] orientation: the a_i-case value
+                # Y[j, i] = (a_j|b_j) ? row_bpub[i] : cpb[j, i] IS y_val
+                # read as (j, i) — no transpose needed (the whole point of
+                # the transposed storage); the a_j-case value W[i, i] is the
+                # (M, R) vector wo below.
+                wo = jnp.where(onehot_b, row_bpub, cpb_diag)  # (M, R)
                 ocp = jnp.where(
-                    condT_pub,
-                    row_bpub[None, :, :],
-                    jnp.where(condT_bj, cpb, jnp.where(condT_bi, cpb_diag[None, :, :], ocp)),
+                    adopt[None, :, :],  # a_i (i on sublanes in [j, i])
+                    y_val,
+                    jnp.where(adopt[:, None, :], wo[None, :, :], ocp),
                 )
                 npriv = jnp.where(adopt, 0, npriv)
                 bhp = jnp.where(do, best_h, bhp)

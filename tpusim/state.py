@@ -465,39 +465,45 @@ def notify(
 
     if cp is not None:
         # cpb[j, o] = cp[b, j, o]. Its j == b row comes from a stale i == j
-        # plane of the stored tensor, but every consumer below excludes it
-        # (cond_bj/cond_bi and their transposes all carry ~onehot_b; the
-        # cond_pub value row_bpub is derived from own_in instead), so no
-        # correction is needed.
+        # plane of the stored tensor, but no consumer reads it: the
+        # onehot_b selects inside y_val/w_val (and yo/wo) overwrite the
+        # b-row with row_bpub — derived from own_in, not cpb — wherever a
+        # b-indexed value is used, so no correction is needed.
         cpb = jnp.sum(cp * b32[:, None, None], axis=0, dtype=I32)  # [M, M]
         cpb_diag = jnp.sum(cpb * jnp.eye(m, dtype=I32), axis=1, dtype=I32)  # [i] = cp[b, i, i]
 
         # Closed-form cp update: every adopter's chain becomes b's published
-        # chain; case analysis in the conds below.
-        is_b_i = onehot_b[:, None]
-        is_b_j = onehot_b[None, :]
+        # chain. Factored form — the historical 3-level case analysis
+        #   cond_pub = (a_i & (a_j | b_j)) | (b_i & a_j) -> row_bpub
+        #   cond_bj  = a_i & ~a_j & ~b_j                 -> cpb[j]
+        #   cond_bi  = ~a_i & ~b_i & a_j                 -> cpb[i]
+        # is entry-for-entry equal (diagonals included; checked case-by-case
+        # using a_b = False, i.e. the best owner never adopts) to TWO
+        # tensor-rank selects over precomputed row values:
+        #   Y[j] = (a_j | b_j) ? b_pub : cpb[j]   (what any adopter's row j
+        #                                          becomes)
+        #   W[i] = b_i ? b_pub : cpb[i]           (what row i contributes to
+        #                                          an adopting column j)
+        #   cp[i,j] = a_i ? Y[j] : (a_j ? W[i] : cp[i,j])
+        # One fewer select over the (M, M, M) tensor — the single most
+        # expensive op of the exact sweep — and two fewer composed masks.
         a_i = adopt[:, None]
         a_j = adopt[None, :]
-        cond_pub = (a_i & (a_j | is_b_j)) | (is_b_i & a_j)
-        cond_bj = a_i & ~a_j & ~is_b_j
-        cond_bi = ~a_i & ~is_b_i & a_j
+        ab = adopt | onehot_b
+        y_val = jnp.where(ab[:, None], row_bpub[None, :], cpb)  # [M, M]
+        w_val = jnp.where(onehot_b[:, None], row_bpub[None, :], cpb)  # [M, M]
         cp = jnp.where(
-            cond_pub[:, :, None],
-            row_bpub[None, None, :],
-            jnp.where(
-                cond_bj[:, :, None],
-                cpb[None, :, :],
-                jnp.where(cond_bi[:, :, None], cpb[:, None, :], cp),
-            ),
+            a_i[:, :, None],
+            y_val[None, :, :],
+            jnp.where(a_j[:, :, None], w_val[:, None, :], cp),
         )
-        # The o == i slices of the same update keep own_cp exact:
-        # cond_pub -> row_bpub[i]; cond_bj -> cp[b, j, i] = cpb[j, i] (the
-        # transpose); cond_bi -> cp[b, i, i] = diag(cpb).
-        own_cp = jnp.where(
-            cond_pub,
-            row_bpub[:, None],
-            jnp.where(cond_bj, cpb.T, jnp.where(cond_bi, cpb_diag[:, None], own_cp)),
-        )
+        # The o == i slices of the same update keep own_cp exact; same
+        # factoring with the sliced values: Y[j, i] = (a_j | b_j) ?
+        # row_bpub[i] : cpb[j, i] and W[i, i] = b_i ? row_bpub[i] :
+        # cpb_diag[i].
+        yo = jnp.where(ab[None, :], row_bpub[:, None], cpb.T)  # [i, j]
+        wo = jnp.where(onehot_b, row_bpub, cpb_diag)  # [i]
+        own_cp = jnp.where(a_i, yo, jnp.where(a_j, wo[:, None], own_cp))
     else:
         # Fast pairwise approximation. Adopter rows: the chain IS b_pub now
         # — own blocks above any lca become 0, i.e. own_cp[i, :] =
