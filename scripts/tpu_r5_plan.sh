@@ -35,6 +35,10 @@ run_step exactsweep  python scripts/tpu_exact_sweep.py --runs 2048 --n-chunks 12
 run_step bench       python bench.py --target-seconds 30 --exact-target-seconds 20 \
                        --probe-retries 1
 run_step refscale    python scripts/refscale.py --backend tpu --config default1s
+run_step gridfast    python -m tpusim.sweep propagation --runs-scale 1.0 \
+                       --max-points 2 \
+                       --out artifacts/sweep_propagation_full_r5.jsonl \
+                       --checkpoint-dir artifacts/ck_prop_full --quiet
 run_step gridpoint   python -m tpusim.sweep selfish-hashrate --runs-scale 1.0 \
                        --max-points 2 \
                        --out artifacts/sweep_selfish_hashrate_full_r5.jsonl \
