@@ -10,8 +10,9 @@ control:
   * pallas vs scan (the r4 open question: a 4-miner smoke hinted exact pallas
     may be 0.78x scan after the lazy-diagonal rewrite; this decides
     make_engine's exact routing from data)
-  * group_slots 4 (exact default) vs 2 (the split-slot kernel specialization,
-    which bought the fast path 1.58x)
+  * group_slots 2 (the auto default since round 5; the split-slot kernel
+    specialization that bought the fast path 1.58x) vs 4 (the pre-round-5
+    exact default, the generic K-slot machinery)
   * tile_runs 256 (VMEM-guard limit) vs 512 with the guard bypassed (the
     lazy-diagonal rewrite shrank contraction temporaries; only the real
     compiler can say whether 512 now fits)

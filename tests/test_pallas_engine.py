@@ -38,16 +38,18 @@ SELFISH40 = reference_selfish_network()
     [
         (default_network(propagation_ms=10_000), 4 * 86_400_000, 128, "fast", None),  # chunked, racy
         (HETERO, 1_200_000, 64, "fast", None),  # heterogeneous + 0 ms propagation edge
-        (default_network(propagation_ms=10_000), 2 * 86_400_000, 64, "exact", None),  # exact honest
-        (SELFISH40, 4 * 86_400_000, 128, "exact", None),  # gamma=0 selfish machinery
-        # Non-default K=4 fast: covers the kernel's generic K-slot group
-        # machinery, which the K=2 default routes around (the split-slot path).
+        # Explicit K=4 exact rows: the generic K-slot group machinery
+        # (one-hot push/flush/compact and the generic reveal push), which
+        # the K=2 auto default otherwise routes around — still reachable
+        # via group_slots=4 (the pre-round-5 resolved configs).
+        (default_network(propagation_ms=10_000), 2 * 86_400_000, 64, "exact", 4),
+        (SELFISH40, 4 * 86_400_000, 128, "exact", 4),
+        # Non-default K=4 fast: same generic machinery in the fast kernel.
         (default_network(propagation_ms=10_000), 2 * 86_400_000, 64, "fast", 4),
-        # Non-default K=2 exact: the split-slot specialization in the exact
-        # kernel (incl. the split-slot reveal push), the perf opt-in for
-        # selfish/10s sweeps.
-        (SELFISH40, 4 * 86_400_000, 128, "exact", 2),
-        (default_network(propagation_ms=10_000), 2 * 86_400_000, 64, "exact", 2),
+        # Auto (K=2) exact: the split-slot specialization incl. the
+        # split-slot reveal push — what production selfish/10s sweeps run.
+        (SELFISH40, 4 * 86_400_000, 128, "exact", None),
+        (default_network(propagation_ms=10_000), 2 * 86_400_000, 64, "exact", None),
     ],
 )
 def test_pallas_matches_scan_engine_exactly(network, duration_ms, chunk_steps, mode, group_slots):

@@ -137,14 +137,13 @@ class SimConfig:
     #: device-loop dispatch; still inside the int32 block-count-sum guard for
     #: year-long runs). The runner clamps to the remaining run count.
     batch_size: int = 8192
-    #: In-flight arrival-group buffer slots per (run, miner); None = auto.
-    #: Auto resolves to 2 in fast mode (its accuracy domain caps the race
-    #: ratio at ~1e-2, where a third concurrent own-group needs two own
-    #: finds inside one propagation window, ~(share*ratio)^2 per block —
-    #: measured 31 counted overflows in 4.3e8 blocks at the reference
-    #: default, and K-sized ops are ~20% of step time) and 4 in exact mode
-    #: (selfish reveals push multi-group bursts). Overflow merges the two
-    #: newest groups, counted in the reported ``overflow_sum`` diagnostic.
+    #: In-flight arrival-group buffer slots per (run, miner); None = auto
+    #: (2 in both modes — see ``resolved_group_slots`` for the measured
+    #: basis; fast mode's accuracy domain caps the race ratio at ~1e-2,
+    #: where a third concurrent own-group is a ~(share*ratio)^2 per-block
+    #: event: 31 counted overflows in 4.3e8 blocks at the reference
+    #: default). Overflow merges the two newest groups, counted in the
+    #: reported ``overflow_sum`` diagnostic.
     group_slots: int | None = None
     mode: str = "auto"
     chunk_steps: int | None = None
@@ -188,9 +187,18 @@ class SimConfig:
 
     @property
     def resolved_group_slots(self) -> int:
+        # Auto resolves to 2 in BOTH modes (round 5; exact was 4 through
+        # round 4). Measured basis: selfish reveals push their whole burst
+        # as ONE merged (arrival, count) group, so deep buffers are unneeded
+        # — at 512 runs x 365 d, selfish40 has 0 overflow-merges in 18.1M
+        # blocks (statistics identical to K=4) and honest-10s has 192 in
+        # 26.6M (stale-rate shift ~1.2e-6, two orders under the ±1e-4
+        # criterion) — while K=2 engages the kernels' dense split-slot path
+        # and is faster on every measured engine/config (BASELINE.md round-5
+        # notes). Overflow merges stay counted in ``overflow_sum``.
         if self.group_slots is not None:
             return self.group_slots
-        return 4 if self.resolved_mode == "exact" else 2
+        return 2
 
     @property
     def resolved_mode(self) -> str:

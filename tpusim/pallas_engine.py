@@ -136,9 +136,11 @@ def _make_kernel(
         # onto 8x128 vregs, so K=2 uses 2 of 8 sublanes — 75% of the vector
         # unit idles. Carrying the slots as 2xK (M, R) arrays through the
         # step loop instead makes every group op fully dense; ablation
-        # timing attributed ~50% of the fast step to exactly these ops
-        # (exact mode's default K is 4; group_slots=2 opts an exact config
-        # into this path, overflow-merge diagnostics counted as always).
+        # timing attributed ~50% of the fast step to exactly these ops.
+        # K=2 is the auto default in BOTH modes since round 5 (measured
+        # overflow/accuracy basis in SimConfig.resolved_group_slots);
+        # group_slots>=3 takes the generic path, overflow-merge
+        # diagnostics counted either way.
         split2 = k == 2
 
         def push_groups(garr, gcnt, arrival, count, do):
