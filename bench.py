@@ -27,6 +27,7 @@ import json
 import os
 import signal
 import sys
+import threading
 import time
 
 CPU_CORE_BASELINE_SIM_YEARS_PER_S = 86.0
@@ -112,24 +113,54 @@ def main() -> int:
 
     phase = "backend-init"
     info: dict = {}
+    partial: dict = {}  # last fully-measured payload (fast headline) if any
+    done = threading.Event()
+    _emit_lock = threading.Lock()
+    _emitted = [False]
 
-    def fail(err: Exception | str) -> int:
-        payload = {
-            "metric": "sim_years_per_sec_per_chip (FAILED)",
-            "value": 0.0,
-            "unit": "sim-years/s/chip",
-            "vs_baseline": 0.0,
-            "error": str(err)[:500],
-            "phase": phase,
-            **info,
-        }
-        # Only when the TPU was genuinely unreached: a failure ON the chip
-        # must not be dressed up as a tunnel outage with stale cached rows.
-        if info.get("platform") != "tpu":
+    def emit_once(payload: dict) -> None:
+        # Exactly ONE JSON line even if the watchdog thread and the (late)
+        # main thread both reach an emit path.
+        with _emit_lock:
+            if _emitted[0]:
+                return
+            _emitted[0] = True
+        emit(payload)
+
+    def fail(err: Exception | str, *, wedged: bool = False) -> int:
+        if partial:
+            # The fast headline DID complete on hardware; a later phase
+            # failing must not replace a real measurement with a zero.
+            payload = {**partial,
+                       "error": str(err)[:500], "error_phase": phase}
+        else:
+            payload = {
+                "metric": "sim_years_per_sec_per_chip (FAILED)",
+                "value": 0.0,
+                "unit": "sim-years/s/chip",
+                "vs_baseline": 0.0,
+                "error": str(err)[:500],
+                "phase": phase,
+                **info,
+            }
+        # Cached on-chip rows attach when the TPU was never reached, or when
+        # a watchdog fired (wedge) — but a genuine failure ON a live chip
+        # must not be dressed up as a tunnel outage with stale rows, so a
+        # post-probe wedge gets an honest note: from inside the process a
+        # mid-run tunnel death and an on-chip overrun are indistinguishable.
+        if info.get("platform") != "tpu" or wedged:
             cached = cached_tpu_numbers()
             if cached is not None:
-                payload["cached_tpu"] = cached
-        emit(payload)
+                if info.get("platform") == "tpu":
+                    cached = {**cached, "note": (
+                        "last builder-measured on-chip values "
+                        "(artifacts/perf_tpu.jsonl); the watchdog fired after "
+                        "the TPU probe succeeded — either the tunnel died "
+                        "mid-run or the run overran the timeout on a live chip"
+                    )}
+                payload.setdefault("cached_tpu", cached)
+        done.set()
+        emit_once(payload)
         return 1
 
     def on_alarm(signum, frame):
@@ -137,6 +168,26 @@ def main() -> int:
 
     signal.signal(signal.SIGALRM, on_alarm)
     signal.alarm(int(args.hard_timeout))
+
+    def thread_watchdog():
+        # SIGALRM cannot preempt a main thread blocked inside the PJRT
+        # client's C wait — the observed failure mode when the tunnel dies
+        # mid-run (round 5: smoke-phase run_batch futex-parked for 20+ min).
+        # This daemon thread is the escape hatch that still prints the one
+        # JSON line (with cached on-chip rows and any partial measurement)
+        # and then hard-exits; 90 s of grace lets the alarm path win when
+        # the main thread is interruptible.
+        deadline = time.monotonic() + args.hard_timeout + 90.0
+        while time.monotonic() < deadline:
+            if done.wait(timeout=5.0):
+                return
+        fail(f"hard watchdog: main thread still blocked after "
+             f"{args.hard_timeout + 90:.0f}s in phase {phase}", wedged=True)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(1)
+
+    threading.Thread(target=thread_watchdog, daemon=True).start()
 
     try:
         # --- Phase: backend init with subprocess probes + CPU fallback
@@ -208,8 +259,9 @@ def main() -> int:
                     )
                     log(f"ablate {tag}: {results[tag]}")
             signal.alarm(0)
+            done.set()
             first = next(iter(results.values()))
-            emit({
+            emit_once({
                 "metric": f"us_per_step (chained-chunk ablation, {platform})",
                 "value": first["us_per_step"],
                 "unit": "us/step",
@@ -314,6 +366,25 @@ def main() -> int:
         elapsed = time.perf_counter() - t0
         sim_years_per_s = total_runs * years_per_run / elapsed
 
+        def headline_payload() -> dict:
+            return {
+                "metric": (
+                    f"sim_years_per_sec_per_chip ({platform}/{info['engine']}, "
+                    f"{total_runs} runs x 365d, 9-miner honest)"
+                ),
+                "value": round(sim_years_per_s, 3),
+                "unit": "sim-years/s/chip",
+                "vs_baseline": round(
+                    sim_years_per_s / CPU_CORE_BASELINE_SIM_YEARS_PER_S, 3
+                ),
+                "elapsed_s": round(elapsed, 2),
+                **info,
+            }
+
+        # From here on the fast headline is a real on-hardware measurement;
+        # if the exact phase wedges or fails, emit THIS instead of a zero.
+        partial.update(headline_payload())
+
         # --- Phase: exact-mode headline. Every selfish and >=10s-propagation
         # production sweep resolves to exact mode, so the headline fast-mode
         # number alone cannot show regressions where the science lives. The
@@ -362,27 +433,18 @@ def main() -> int:
             log(f"exact headline: {einfo}")
 
         signal.alarm(0)
-        payload = {
-            "metric": (
-                f"sim_years_per_sec_per_chip ({platform}/{info['engine']}, "
-                f"{total_runs} runs x 365d, 9-miner honest)"
-            ),
-            "value": round(sim_years_per_s, 3),
-            "unit": "sim-years/s/chip",
-            "vs_baseline": round(sim_years_per_s / CPU_CORE_BASELINE_SIM_YEARS_PER_S, 3),
-            "elapsed_s": round(elapsed, 2),
-            **info,
-        }
+        payload = headline_payload()  # re-built: the exact phase added info
         if platform != "tpu":
             cached = cached_tpu_numbers()
             if cached is not None:
                 payload["cached_tpu"] = cached
-        emit(payload)
+        done.set()
+        emit_once(payload)
         return 0
     except BaseException as e:  # noqa: BLE001 — the JSON line must always appear
         if isinstance(e, (KeyboardInterrupt, SystemExit)):
             return fail(f"interrupted: {e!r}")
-        return fail(e)
+        return fail(e, wedged=isinstance(e, _Watchdog))
 
 
 if __name__ == "__main__":

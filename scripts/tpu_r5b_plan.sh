@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Round-5 SECOND-WINDOW playbook: the steps the 03:47-03:50 window did not
+# reach before the tunnel died (plus the fixed sweep/micro harnesses).
+#
+#   bash scripts/tpu_r5b_plan.sh [logdir]
+#
+# Value order (highest first, same rationale as tpu_r5_plan.sh):
+#   1. bench headline    — driver-format JSON, both modes (bench.py is now
+#                          wedge-proof: thread watchdog + partial emission)
+#   2. refscale default1s — float64-finalize share-diff evidence on TPU
+#   3. full-scale grid   — selfish-hashrate configs[2] 2 points at 2^20 runs,
+#                          checkpointed (resumable across windows)
+#   4. full-scale grid   — propagation configs[0] 2 points
+#   5. mosaic micro      — flattening decision, now with the iter-scaling
+#                          self-check (first capture was floor-limited)
+#   6. exact sweep       — re-run incl. the fixed t384/step128 points;
+#                          guard-off t512 points run last (helper-crash risk)
+set -u
+LOG="${1:-artifacts/r5b_tpu_logs}"
+mkdir -p "$LOG"
+cd "$(dirname "$0")/.."
+
+run_step() {
+  local name="$1"; shift
+  echo "=== [$(date -u +%H:%M:%S)] $name: $*" | tee -a "$LOG/plan.log"
+  if "$@" >"$LOG/$name.out" 2>"$LOG/$name.err"; then
+    echo "=== $name OK" | tee -a "$LOG/plan.log"
+  else
+    echo "=== $name FAILED rc=$? (continuing)" | tee -a "$LOG/plan.log"
+  fi
+}
+
+run_step bench       python bench.py --target-seconds 30 --exact-target-seconds 20 \
+                       --probe-retries 1 --hard-timeout 900
+run_step refscale    timeout -k 10 1200 python scripts/refscale.py --backend tpu --config default1s
+run_step gridpoint   timeout -k 10 3600 python -m tpusim.sweep selfish-hashrate --runs-scale 1.0 \
+                       --max-points 2 \
+                       --out artifacts/sweep_selfish_hashrate_full_r5.jsonl \
+                       --checkpoint-dir artifacts/ck_sh_full --quiet
+run_step gridfast    timeout -k 10 3600 python -m tpusim.sweep propagation --runs-scale 1.0 \
+                       --max-points 2 \
+                       --out artifacts/sweep_propagation_full_r5.jsonl \
+                       --checkpoint-dir artifacts/ck_prop_full --quiet
+run_step micro       timeout -k 10 1200 python scripts/mosaic_micro.py --iters 4096
+run_step exactsweep  timeout -k 10 2400 python scripts/tpu_exact_sweep.py --runs 2048 --n-chunks 12
+echo "=== plan complete; see $LOG" | tee -a "$LOG/plan.log"

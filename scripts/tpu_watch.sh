@@ -1,20 +1,25 @@
 #!/bin/bash
 # TPU tunnel watcher: probes the backend every ~7 min (SIGKILL-backed
 # timeout — the wedged tunnel ignores SIGTERM in C land) and, on the first
-# UP, runs the round's measurement playbook exactly once.
+# UP, runs the given playbook exactly once.
 #
-#   setsid nohup bash scripts/tpu_watch.sh >/dev/null 2>&1 &
+#   setsid nohup bash scripts/tpu_watch.sh scripts/tpu_r5b_plan.sh r5b >/dev/null 2>&1 &
 #
-# Log: /tmp/tpu_watch.log. One-shot latch: /tmp/r5_plan_started.
+# Log: /tmp/tpu_watch.log. One-shot latch: /tmp/<tag>_plan_started.
 cd "$(dirname "$0")/.."
+PLAN="${1:-scripts/tpu_r5_plan.sh}"
+# Default the latch tag to the plan's basename so a new plan never silently
+# reuses an older plan's one-shot latch (which would eat the tunnel window).
+TAG="${2:-$(basename "$PLAN" .sh)}"
+LATCH="/tmp/${TAG}_plan_started"
 while true; do
   if timeout -k 5 150 python -c "import jax; assert jax.devices()[0].platform=='tpu'" >/dev/null 2>&1; then
     echo "$(date -u +%FT%TZ) TPU UP" >> /tmp/tpu_watch.log
-    if [ ! -f /tmp/r5_plan_started ]; then
-      touch /tmp/r5_plan_started
-      echo "$(date -u +%FT%TZ) launching r5 plan" >> /tmp/tpu_watch.log
-      bash scripts/tpu_r5_plan.sh artifacts/r5_tpu_logs >> /tmp/tpu_watch.log 2>&1
-      echo "$(date -u +%FT%TZ) r5 plan finished; watcher exiting" >> /tmp/tpu_watch.log
+    if [ ! -f "$LATCH" ]; then
+      touch "$LATCH"
+      echo "$(date -u +%FT%TZ) launching $PLAN" >> /tmp/tpu_watch.log
+      bash "$PLAN" >> /tmp/tpu_watch.log 2>&1
+      echo "$(date -u +%FT%TZ) $PLAN finished; watcher exiting" >> /tmp/tpu_watch.log
       exit 0
     fi
   else

@@ -82,9 +82,21 @@ def probe_or_force_cpu(
     """
     platform = probe_backend(timeout_s, retries, backoff_s, log)
     if platform is None:
-        os.environ.pop(TUNNEL_TRIGGER_ENV, None)
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+        force_cpu()
     return platform
+
+
+def force_cpu() -> None:
+    """Force this process onto local CPU, bypassing the tunnel plugin.
+
+    Clears the plugin trigger env (for child processes), sets JAX_PLATFORMS,
+    and forces the platform through ``jax.config`` — the config update is
+    what actually works once sitecustomize has registered the plugin at
+    interpreter startup; it is valid any time before the first backend
+    initialization, whether or not jax is imported yet. Also used by
+    scripts/mosaic_micro.py --allow-cpu."""
+    os.environ.pop(TUNNEL_TRIGGER_ENV, None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
