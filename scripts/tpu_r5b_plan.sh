@@ -15,6 +15,8 @@
 #                          self-check (first capture was floor-limited)
 #   6. exact sweep       — re-run incl. the fixed t384/step128 points;
 #                          guard-off t512 points run last (helper-crash risk)
+#   7. kernel traces     — XLA device traces of a short run per mode
+#                          (op-level attribution; 2 x <=900 s budget)
 set -u
 LOG="${1:-artifacts/r5b_tpu_logs}"
 mkdir -p "$LOG"
@@ -43,4 +45,13 @@ run_step gridfast    timeout -k 10 3600 python -m tpusim.sweep propagation --run
                        --checkpoint-dir artifacts/ck_prop_full --quiet
 run_step micro       timeout -k 10 1200 python scripts/mosaic_micro.py --iters 4096
 run_step exactsweep  timeout -k 10 2400 python scripts/tpu_exact_sweep.py --runs 2048 --n-chunks 12
+# Op-level attribution of the post-split-slot kernels: XLA device traces of
+# a short run in each mode (chrome-trace JSON inside, parseable offline).
+run_step tracefast   timeout -k 10 900 python -m tpusim --runs 8192 --days 30 \
+                       --batch-size 8192 --propagation-ms 1000 \
+                       --trace-dir artifacts/trace_fast_r5
+run_step traceexact  timeout -k 10 900 python -m tpusim --runs 2048 --days 30 \
+                       --batch-size 2048 --propagation-ms 1000 \
+                       --selfish 0 --hashrates 40,19,12,11,8,5,3,1,1 \
+                       --trace-dir artifacts/trace_exact_r5
 echo "=== plan complete; see $LOG" | tee -a "$LOG/plan.log"

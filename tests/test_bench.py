@@ -1,0 +1,88 @@
+"""bench.py robustness machinery: the cached on-chip row lookup and the
+always-one-JSON-line contract under the failure/watchdog paths.
+
+Rationale (round 5): the driver captures bench.py's stdout as the round's
+BENCH artifact, and the TPU tunnel has died mid-run in three rounds. The
+hardened bench must (a) surface the last builder-measured on-chip numbers
+whenever the chip is unreachable, and (b) emit exactly one JSON line no
+matter how it dies — these tests pin both against the reference scenario of
+an output-less wedge (the empty BENCH_r01/r02 failure mode).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+bench = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(bench)
+
+
+def _write_rows(path: Path, rows: list[dict]) -> None:
+    with open(path, "w") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+
+
+def test_cached_tpu_numbers_picks_last_row_per_mode(tmp_path):
+    log = tmp_path / "perf.jsonl"
+    _write_rows(log, [
+        {"chip": "TPU v5e", "mode": "fast", "sim_years_per_s": 100, "date": "d1"},
+        {"chip": "container CPU", "mode": "fast", "sim_years_per_s": 9},  # not TPU
+        {"chip": "TPU v5e", "mode": "fast", "sim_years_per_s": "broken"},  # non-numeric
+        {"chip": "TPU v5e", "note": "no rate field"},
+        {"chip": "TPU v5 lite0", "mode": "fast", "sim_years_per_s": 200, "date": "d2"},
+        {"chip": "TPU v5 lite0", "mode": "exact", "sim_years_per_s": 50, "date": "d2"},
+    ])
+    cached = bench.cached_tpu_numbers(str(log))
+    assert cached["fast"]["sim_years_per_s"] == 200  # last valid TPU fast row
+    assert cached["fast"]["date"] == "d2"
+    assert cached["exact"]["sim_years_per_s"] == 50
+    assert "note" in cached
+
+
+def test_cached_tpu_numbers_missing_or_empty(tmp_path):
+    assert bench.cached_tpu_numbers(str(tmp_path / "nope.jsonl")) is None
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("not json at all\n")
+    assert bench.cached_tpu_numbers(str(empty)) is None
+
+
+def test_repo_perf_log_has_both_modes():
+    """The committed perf log must keep feeding both cached modes: a future
+    edit that drops the exact-mode rows would silently halve the fallback."""
+    cached = bench.cached_tpu_numbers()
+    assert cached is not None
+    assert cached["fast"] and cached["fast"]["sim_years_per_s"] > 0
+    assert cached["exact"] and cached["exact"]["sim_years_per_s"] > 0
+
+
+def test_bench_watchdog_emits_single_json_line():
+    """A bench that exceeds --hard-timeout must still print exactly one JSON
+    line (schema + error + phase + cached_tpu) and exit nonzero."""
+    env = os.environ.copy()
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--hard-timeout", "3",
+         "--probe-retries", "1", "--probe-timeout", "60",
+         "--target-seconds", "1", "--exact-target-seconds", "0",
+         "--batch-size", "8"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO,
+    )
+    assert r.returncode == 1
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, r.stdout
+    payload = json.loads(lines[0])
+    assert payload["value"] == 0.0
+    assert "watchdog" in payload["error"]
+    assert payload["phase"]
+    # CPU-forced run: the cached on-chip story must ride along.
+    assert payload["cached_tpu"]["fast"]["sim_years_per_s"] > 0
