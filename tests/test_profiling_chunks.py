@@ -1,0 +1,62 @@
+"""tpusim.profiling.time_chained_chunks and runner.make_engine strictness.
+
+The chained-chunk timer is the canonical kernel-timing discipline (every
+round-5 routing decision rests on its numbers), and make_engine's
+tuning-override strictness protects on-hardware sweeps from silently
+measuring the wrong engine — both deserve contract tests, not just use.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tpusim import SimConfig, default_network
+from tpusim.engine import Engine
+from tpusim.profiling import time_chained_chunks
+from tpusim.runner import make_engine, make_run_keys
+
+
+def _small_config() -> SimConfig:
+    return SimConfig(
+        network=default_network(propagation_ms=1000),
+        duration_ms=86_400_000,
+        runs=16,
+        batch_size=16,
+        seed=3,
+        chunk_steps=32,
+    )
+
+
+def test_time_chained_chunks_contract():
+    config = _small_config()
+    engine = Engine(config)
+    keys = make_run_keys(config.seed, 0, config.runs)
+    r = time_chained_chunks(engine, keys, n_chunks=3, repeats=2)
+    assert r["engine"] == "Engine"
+    assert r["runs"] == 16
+    assert r["n_chunks"] == 3
+    assert r["chunk_steps"] == 32
+    # The program must actually run: a dead-code-eliminated loop shows up as
+    # a microsecond-scale per-chunk time (documented failure mode in the
+    # profiling docstring); 32 steps x 16 runs cannot finish in under 10 us
+    # even on a fast CPU.
+    assert r["s_per_chunk"] > 1e-5
+    # Both fields are independently rounded for the JSONL artifact, so the
+    # identity only holds to rounding precision.
+    assert r["us_per_step"] == pytest.approx(r["s_per_chunk"] / 32 * 1e6, rel=1e-2)
+    assert len(r["repeats_s"]) == 2
+    assert r["spread_pct"] >= 0.0
+
+
+def test_make_engine_rejects_tuning_overrides_off_tpu():
+    """On a platform that auto-routes to the scan engine, kernel-tuning
+    overrides must raise instead of silently measuring the scan engine
+    (runner.make_engine) — the failure mode that would corrupt every
+    on-hardware sweep point captured through the runner."""
+    config = _small_config()
+    with pytest.raises(ValueError, match="auto-routes"):
+        make_engine(config, tile_runs=256)
+    with pytest.raises(ValueError, match="auto-routes"):
+        make_engine(config, step_block=32)
+    # Without overrides the auto route quietly picks the scan engine.
+    assert type(make_engine(config)) is Engine
