@@ -11,6 +11,8 @@ race-window scales, and both consensus representations.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 from hypothesis import assume, given, settings, strategies as st
 
@@ -25,6 +27,11 @@ from tpusim.config import (
 from tpusim.testing import assert_state_matches_chains, drive_state_events
 
 DURATION_MS = 400_000  # ~20 blocks at the 20 s interval used below
+
+# CI default 100; raise for deep fuzz sessions (idle hardware windows), e.g.
+#   TPUSIM_HYPOTHESIS_EXAMPLES=2000 pytest tests/test_property_equivalence.py
+# Test-level @settings overrides hypothesis profiles, so the knob lives here.
+MAX_EXAMPLES = int(os.environ.get("TPUSIM_HYPOTHESIS_EXAMPLES", "100"))
 
 
 @st.composite
@@ -99,7 +106,7 @@ def _prepare_case(data, mode):
     return config, intervals, winners
 
 
-@settings(max_examples=100, deadline=None)
+@settings(max_examples=MAX_EXAMPLES, deadline=None)
 @given(data=st.data())
 def test_exact_mode_matches_chain_oracle(data):
     """Exact mode is observationally identical to the literal-chain oracle on
@@ -117,7 +124,7 @@ def test_exact_mode_matches_chain_oracle(data):
     assert_state_matches_chains(state, oracle["chains"], config.duration_ms, config)
 
 
-@settings(max_examples=100, deadline=None)
+@settings(max_examples=MAX_EXAMPLES, deadline=None)
 @given(data=st.data())
 def test_fast_mode_contract_vs_chain_oracle(data):
     """Fast mode's documented contract (tpusim.state docstring), held even on
