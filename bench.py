@@ -258,6 +258,23 @@ def main() -> int:
                         eng_ab, make_run_keys(7, 0, runs_ab), n_chunks
                     )
                     log(f"ablate {tag}: {results[tag]}")
+            # Self-record on-chip rows in the perf log (the r5 window's rows
+            # had to be hand-copied; a dead tunnel must never depend on a
+            # human remembering to transcribe stdout). CPU rows stay out —
+            # cached_tpu_numbers() reads this file and must only ever see
+            # hardware measurements.
+            if platform == "tpu":
+                try:
+                    with open(PERF_LOG, "a") as f:
+                        for tag, row in results.items():
+                            f.write(json.dumps({
+                                "date": time.strftime("%Y-%m-%d"),
+                                "chip": str(jax.devices()[0]),
+                                "measurement": f"bench.py --ablate {tag}",
+                                **row,
+                            }) + "\n")
+                except OSError as e:
+                    log(f"could not append ablation rows to {PERF_LOG}: {e}")
             signal.alarm(0)
             done.set()
             first = next(iter(results.values()))
