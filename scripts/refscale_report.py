@@ -131,6 +131,14 @@ def main() -> int:
         return 1
 
     baseline = json.loads((REPO / "BASELINE.json").read_text())
+    # Preserve sibling evidence blocks other scripts maintain under
+    # `published` (update_fullscale_published.py owns `full_scale_grids`).
+    prior = baseline.get("published", {})
+    extra = {
+        k: v
+        for k, v in prior.items()
+        if k not in ("scale", "criterion", "all_within_tolerance", "configs")
+    }
     baseline["published"] = {
         "scale": "32768 runs x 365.2425 d per config (reference main.cpp:7-10)",
         "criterion": (
@@ -142,8 +150,11 @@ def main() -> int:
         ),
         "all_within_tolerance": ok,
         "configs": published,
+        **extra,
     }
-    (REPO / "BASELINE.json").write_text(json.dumps(baseline, indent=2) + "\n")
+    # indent=1 matches update_fullscale_published.py so alternating runs of
+    # the two scripts don't re-indent (and churn) the whole file.
+    (REPO / "BASELINE.json").write_text(json.dumps(baseline, indent=1) + "\n")
 
     lines = [
         "# REFSCALE — full-scale reproduction of the reference tables",

@@ -1,0 +1,55 @@
+"""scripts/refscale_report.py must merge, not replace, BASELINE.json's
+``published`` block: `full_scale_grids` is owned by
+scripts/update_fullscale_published.py, and a report re-run after a grid
+update must not erase it (regression: round 5, where a re-run dropped the
+committed full-scale evidence)."""
+
+import importlib.util
+import json
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_report_module():
+    spec = importlib.util.spec_from_file_location(
+        "refscale_report", REPO / "scripts" / "refscale_report.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _miner(hashrate_pct, selfish=False):
+    return {
+        "hashrate_pct": hashrate_pct,
+        "selfish": selfish,
+        "blocks_found_mean": 1000.0 * hashrate_pct,
+        "blocks_share_mean": hashrate_pct / 100.0,
+        "stale_rate_mean": 0.001,
+        "stale_blocks_mean": 1.0,
+    }
+
+
+def test_report_preserves_full_scale_grids(tmp_path, monkeypatch, capsys):
+    mod = _load_report_module()
+    art = tmp_path / "artifacts"
+    art.mkdir()
+    miners = [_miner(h) for h in (30, 29, 12, 11, 8, 5, 3, 1, 1)]
+    (art / "refscale_default1s_tpu.json").write_text(
+        json.dumps({"runs": 32768, "sim_years_per_s": 1000.0, "miners": miners})
+    )
+    grids = {"note": "owned by update_fullscale_published.py", "selfish_hashrate": {}}
+    (tmp_path / "BASELINE.json").write_text(
+        json.dumps({"metric": "m", "published": {"full_scale_grids": grids}})
+    )
+    monkeypatch.setattr(mod, "REPO", tmp_path)
+    monkeypatch.setattr(mod, "ART", art)
+
+    assert mod.main() == 0
+    out = json.loads((tmp_path / "BASELINE.json").read_text())
+    assert out["metric"] == "m"  # top-level keys untouched
+    pub = out["published"]
+    assert pub["full_scale_grids"] == grids  # sibling evidence preserved
+    assert "default1s" in pub["configs"]  # report's own block written
+    assert (tmp_path / "REFSCALE.md").exists()
