@@ -15,8 +15,8 @@
 # Each step logs to $logdir and failures do not stop later steps.
 set -u
 LOG="${1:-artifacts/r5_tpu_logs}"
-mkdir -p "$LOG"
 cd "$(dirname "$0")/.."
+mkdir -p "$LOG"
 
 run_step() {
   local name="$1"; shift

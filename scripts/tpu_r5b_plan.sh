@@ -19,8 +19,8 @@
 #                          (op-level attribution; 2 x <=900 s budget)
 set -u
 LOG="${1:-artifacts/r5b_tpu_logs}"
-mkdir -p "$LOG"
 cd "$(dirname "$0")/.."
+mkdir -p "$LOG"
 
 run_step() {
   local name="$1"; shift

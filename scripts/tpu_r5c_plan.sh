@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# Round-5 THIRD-WINDOW playbook: extend the full-scale grids beyond
+# tpu_r5b_plan.sh's first points, while the tunnel holds.
+#
+#   bash scripts/tpu_r5c_plan.sh [logdir]
+#
+# Every sweep below resumes from its per-point checkpoints, so re-running
+# after a tunnel death continues at point granularity. Value order:
+#   1. selfish-hashrate remaining points (exact mode, ~12 min/point at
+#      ~1.4k sim-years/s) — the grid the profitability-crossing evidence
+#      lives in; --max-points raised stepwise so each completed point is
+#      flushed to the JSONL before the next starts.
+#   2. propagation 10 s / 60 s points (exact mode).
+#   3. hetero32 at 2^20 (quarter of the BASELINE 2^22 target; 32-miner
+#      exact off-kernel config — measures the scan engine at scale).
+set -u
+LOG="${1:-artifacts/r5c_tpu_logs}"
+cd "$(dirname "$0")/.."
+mkdir -p "$LOG"
+
+run_step() {
+  local name="$1"; shift
+  echo "=== [$(date -u +%H:%M:%S)] $name: $*" | tee -a "$LOG/plan.log"
+  if "$@" >"$LOG/$name.out" 2>"$LOG/$name.err"; then
+    echo "=== $name OK" | tee -a "$LOG/plan.log"
+  else
+    echo "=== $name FAILED rc=$? (continuing)" | tee -a "$LOG/plan.log"
+  fi
+}
+
+# --resume skips rows already in the JSONL, so each pass fills exactly the
+# missing points (incl. any point r5b's steps left half-done in checkpoints);
+# the stepped --max-points keeps a per-step timeout bound on one point's work
+# while earlier completed points cost only a file read.
+for n in 2 3 4 5 6 7 8 9; do
+  run_step "selfish_p$n" timeout -k 10 2400 python -m tpusim.sweep selfish-hashrate \
+    --runs-scale 1.0 --max-points "$n" --resume \
+    --out artifacts/sweep_selfish_hashrate_full_r5.jsonl \
+    --checkpoint-dir artifacts/ck_sh_full --quiet
+done
+for n in 2 3 4; do
+  run_step "prop_p$n" timeout -k 10 2400 python -m tpusim.sweep propagation \
+    --runs-scale 1.0 --max-points "$n" --resume \
+    --out artifacts/sweep_propagation_full_r5.jsonl \
+    --checkpoint-dir artifacts/ck_prop_full --quiet
+done
+run_step hetero32 timeout -k 10 7200 python -m tpusim.sweep hetero32 \
+  --runs-scale 0.25 --resume \
+  --out artifacts/sweep_hetero32_2e20_r5.jsonl \
+  --checkpoint-dir artifacts/ck_h32 --quiet
+echo "=== plan complete; see $LOG" | tee -a "$LOG/plan.log"

@@ -1,0 +1,75 @@
+"""Sweep-driver resume semantics: re-running a sweep with ``resume`` after an
+interrupted hardware window must fill exactly the missing points — skipping
+any (point, runs, backend) row already in the output JSONL and never
+appending duplicates (a resumed-complete checkpoint would otherwise add a row
+whose elapsed_s reflects only the reload)."""
+
+import json
+
+from tpusim.config import SimConfig, default_network
+from tpusim.sweep import baseline_sweeps, main as sweep_main, run_sweep
+
+
+def _points():
+    net = default_network(propagation_ms=1000)
+    return [
+        ("pt-a", SimConfig(network=net, runs=8, batch_size=8, duration_ms=10**8)),
+        ("pt-b", SimConfig(network=net, runs=8, batch_size=8, duration_ms=10**8)),
+    ]
+
+
+def _rows(path):
+    return [json.loads(line) for line in path.read_text().splitlines() if line.strip()]
+
+
+def test_resume_skips_completed_points(tmp_path, capsys):
+    out = tmp_path / "sweep.jsonl"
+    run_sweep(_points()[:1], out_path=out, quiet=True)
+    assert [r["point"] for r in _rows(out)] == ["pt-a"]
+
+    # Second pass over the full grid: pt-a must be skipped, pt-b run.
+    run_sweep(_points(), out_path=out, resume=True)
+    assert [r["point"] for r in _rows(out)] == ["pt-a", "pt-b"]
+    assert "skipping" in capsys.readouterr().out
+
+    # Fully-complete grid: a resume pass is a no-op.
+    run_sweep(_points(), out_path=out, resume=True, quiet=True)
+    assert [r["point"] for r in _rows(out)] == ["pt-a", "pt-b"]
+
+
+def test_resume_reruns_on_different_scale(tmp_path):
+    out = tmp_path / "sweep.jsonl"
+    run_sweep(_points()[:1], out_path=out, quiet=True)
+    # A different runs_scale is a different measurement, not a duplicate.
+    run_sweep(_points()[:1], out_path=out, resume=True, runs_scale=0.5, quiet=True)
+    rows = _rows(out)
+    assert [r["runs"] for r in rows] == [8, 4]
+
+
+def test_resume_tolerates_corrupt_and_legacy_rows(tmp_path):
+    # A window killed mid-write (timeout -k) leaves a truncated trailing
+    # line; pre-round-5 rows carry no "point" key. Both must read as
+    # not-done — the point runs — rather than crashing the resume pass.
+    out = tmp_path / "sweep.jsonl"
+    out.write_text(json.dumps({"legacy": 1}) + "\n" + '{"point": "pt-a", "ru')
+    rows = run_sweep(_points()[:1], out_path=out, resume=True, quiet=True)
+    assert [r["point"] for r in rows] == ["pt-a"]
+
+
+def test_cli_resume_flag_plumbed(tmp_path, capsys):
+    # The CLI --resume flag must reach run_sweep: with every grid point
+    # already rowed in --out, the command is a fast no-op.
+    out = tmp_path / "sweep.jsonl"
+    points = baseline_sweeps()["selfish-hashrate"]()
+    rows = [
+        {"point": name, "runs": max(1, int(c.runs * 1e-5)), "backend": "tpu"}
+        for name, c in points
+    ]
+    out.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    rc = sweep_main(
+        ["selfish-hashrate", "--runs-scale", "1e-5", "--no-probe",
+         "--resume", "--out", str(out)]
+    )
+    assert rc == 0
+    assert capsys.readouterr().out.count("skipping") == len(points)
+    assert len(_rows(out)) == len(points)  # nothing appended

@@ -122,11 +122,17 @@ def run_sweep(
     out_path: Path | None = None,
     checkpoint_dir: Path | None = None,
     quiet: bool = False,
+    resume: bool = False,
 ) -> list[dict]:
     """Run every point; returns (and optionally appends as JSONL) result dicts.
 
     ``runs_scale`` scales each point's run count (floor, min 1) so the full
-    2^20-2^24 production grids can be smoke-run at any budget.
+    2^20-2^24 production grids can be smoke-run at any budget. With
+    ``resume``, points whose (name, runs, backend) row already exists in
+    ``out_path`` are skipped — so re-running the same command after an
+    interrupted hardware window fills exactly the missing points (in-progress
+    per-point state is picked up from ``checkpoint_dir`` as usual) without
+    appending duplicate rows for finished ones.
     """
     import dataclasses
 
@@ -138,9 +144,27 @@ def run_sweep(
             f"(the pychain oracle returns raw chains, not SimResults)"
         )
 
+    done: set[tuple[str, int, str]] = set()
+    if resume and out_path is not None and out_path.exists():
+        for line in out_path.read_text().splitlines():
+            if not line.strip():
+                continue
+            # A killed window (timeout -k mid-write) can leave a truncated
+            # trailing line, and pre-round-5 rows carry no "point" key; a
+            # resume pass must treat both as not-done, not crash on them.
+            try:
+                row = json.loads(line)
+                done.add((row["point"], row["runs"], row["backend"]))
+            except (json.JSONDecodeError, KeyError):
+                continue
+
     results = []
     for name, config in points:
         runs = max(1, int(config.runs * runs_scale))
+        if (name, runs, backend) in done:
+            if not quiet:
+                print(f"[{name}] already in {out_path}; skipping")
+            continue
         config = dataclasses.replace(config, runs=runs)
         t0 = time.monotonic()
         if backend == "tpu":
@@ -180,6 +204,13 @@ def main(argv: list[str] | None = None) -> int:
         "--max-points", type=int, default=None,
         help="run only the first N points of the grid (full-scale runs in "
         "bounded hardware windows; the rest resume via --checkpoint-dir)",
+    )
+    p.add_argument(
+        "--resume", action="store_true",
+        help="skip points whose (point, runs, backend) row already exists in "
+        "--out — re-running the identical command after an interrupted "
+        "window fills exactly the missing points without appending duplicate "
+        "rows (whose elapsed_s would reflect only the checkpoint reload)",
     )
     p.add_argument("--out", type=Path, help="append one JSON line per point here")
     p.add_argument("--checkpoint-dir", type=Path, help="per-point npz checkpoints (tpu backend)")
@@ -233,6 +264,7 @@ def main(argv: list[str] | None = None) -> int:
         out_path=args.out,
         checkpoint_dir=args.checkpoint_dir,
         quiet=args.quiet,
+        resume=args.resume,
     )
     return 0
 
