@@ -1,0 +1,63 @@
+#!/usr/bin/env bash
+# Round-5 RECOVERY playbook (window 3+): everything the 11:41-12:04 window
+# left unfinished, ordered so each marginal minute of tunnel uptime completes
+# the most valuable remaining evidence. Fully resume-safe: every sweep pass
+# uses --resume (skips rows already in its JSONL) + per-point checkpoints,
+# so re-running this plan after another tunnel death continues, never
+# duplicates. Steps:
+#   1. selfish-28pct finish   — checkpoint is ~60% done from window 2
+#   2. propagation 100ms/1s   — fast-mode full-scale points (~6 min each)
+#   3. mosaic micro           — flattening decision (iter-scaling self-check)
+#   4. exact sweep            — fixed t256x128/t384/step128 points
+#   5. kernel traces          — op-level attribution, one per mode
+#   6. selfish 31..49pct      — stepped, one point per pass
+#   7. propagation 10s/60s    — exact-mode full-scale points
+#   8. hetero32 at 2^20       — long scan-engine point, last
+set -u
+LOG="${1:-artifacts/r5d_tpu_logs}"
+cd "$(dirname "$0")/.."
+mkdir -p "$LOG"
+
+run_step() {
+  local name="$1"; shift
+  echo "=== [$(date -u +%H:%M:%S)] $name: $*" | tee -a "$LOG/plan.log"
+  if "$@" >"$LOG/$name.out" 2>"$LOG/$name.err"; then
+    echo "=== $name OK" | tee -a "$LOG/plan.log"
+  else
+    echo "=== $name FAILED rc=$? (continuing)" | tee -a "$LOG/plan.log"
+  fi
+}
+
+sweep_pass() {  # sweep_pass <name> <timeout> <grid> <max-points> <out> <ckdir> [extra...]
+  local name="$1" to="$2" grid="$3" n="$4" out="$5" ck="$6"; shift 6
+  run_step "$name" timeout -k 10 "$to" python -m tpusim.sweep "$grid" \
+    --runs-scale 1.0 --max-points "$n" --resume \
+    --out "$out" --checkpoint-dir "$ck" --quiet "$@"
+}
+
+SH_OUT=artifacts/sweep_selfish_hashrate_full_r5.jsonl
+PR_OUT=artifacts/sweep_propagation_full_r5.jsonl
+
+sweep_pass selfish_p2 1500 selfish-hashrate 2 "$SH_OUT" artifacts/ck_sh_full
+sweep_pass prop_p1    1200 propagation      1 "$PR_OUT" artifacts/ck_prop_full
+sweep_pass prop_p2    1200 propagation      2 "$PR_OUT" artifacts/ck_prop_full
+run_step micro      timeout -k 10 1200 python scripts/mosaic_micro.py --iters 4096
+run_step exactsweep timeout -k 10 2400 python scripts/tpu_exact_sweep.py --runs 2048 --n-chunks 12
+run_step tracefast  timeout -k 10 900 python -m tpusim --runs 8192 --days 30 \
+                      --batch-size 8192 --propagation-ms 1000 \
+                      --trace-dir artifacts/trace_fast_r5
+run_step traceexact timeout -k 10 900 python -m tpusim --runs 2048 --days 30 \
+                      --batch-size 2048 --propagation-ms 1000 \
+                      --selfish 0 --hashrates 40,19,12,11,8,5,3,1,1 \
+                      --trace-dir artifacts/trace_exact_r5
+for n in 3 4 5 6 7 8 9; do
+  sweep_pass "selfish_p$n" 1500 selfish-hashrate "$n" "$SH_OUT" artifacts/ck_sh_full
+done
+for n in 3 4; do
+  sweep_pass "prop_p$n" 1500 propagation "$n" "$PR_OUT" artifacts/ck_prop_full
+done
+run_step hetero32 timeout -k 10 5400 python -m tpusim.sweep hetero32 \
+  --runs-scale 0.25 --resume \
+  --out artifacts/sweep_hetero32_2e20_r5.jsonl \
+  --checkpoint-dir artifacts/ck_h32 --quiet
+echo "=== plan complete; see $LOG" | tee -a "$LOG/plan.log"

@@ -46,6 +46,17 @@ def test_resume_reruns_on_different_scale(tmp_path):
     assert [r["runs"] for r in rows] == [8, 4]
 
 
+def test_append_after_truncated_line_stays_parseable(tmp_path):
+    # Appending after a truncated final line must not glue the new row onto
+    # the fragment: the completed point's row has to survive the next
+    # --resume scan and update_fullscale_published's bare json.loads.
+    out = tmp_path / "sweep.jsonl"
+    out.write_text('{"point": "selfish-28pct", "ru')  # no trailing newline
+    run_sweep(_points()[:1], out_path=out, resume=True, quiet=True)
+    lines = out.read_text().splitlines()
+    assert json.loads(lines[-1])["point"] == "pt-a"
+
+
 def test_resume_tolerates_corrupt_and_legacy_rows(tmp_path):
     # A window killed mid-write (timeout -k) leaves a truncated trailing
     # line; pre-round-5 rows carry no "point" key. Both must read as

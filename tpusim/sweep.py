@@ -186,7 +186,15 @@ def run_sweep(
         }
         results.append(row)
         if out_path is not None:
-            with out_path.open("a") as fh:
+            with out_path.open("a+") as fh:
+                # A killed window can leave a truncated final line with no
+                # newline; appending directly would glue this row onto the
+                # fragment and make both unparseable.
+                fh.seek(0, 2)
+                if fh.tell() > 0:
+                    fh.seek(fh.tell() - 1)
+                    if fh.read(1) != "\n":
+                        fh.write("\n")
                 fh.write(json.dumps(row) + "\n")
         if not quiet:
             print(f"[{name}] done in {row['elapsed_s']}s ({runs} runs)")
