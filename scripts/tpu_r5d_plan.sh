@@ -50,6 +50,14 @@ run_step traceexact timeout -k 10 900 python -m tpusim --runs 2048 --days 30 \
                       --batch-size 2048 --propagation-ms 1000 \
                       --selfish 0 --hashrates 40,19,12,11,8,5,3,1,1 \
                       --trace-dir artifacts/trace_exact_r5
+# Does a bigger per-dispatch batch close the 3313 end-to-end vs 4342
+# kernel-rate gap, or is the gap tail/noise? Two cheap probes.
+run_step bench16k timeout -k 10 600 python bench.py --batch-size 16384 \
+                    --target-seconds 20 --exact-target-seconds 0 \
+                    --probe-retries 1 --hard-timeout 500
+run_step bench32k timeout -k 10 600 python bench.py --batch-size 32768 \
+                    --target-seconds 20 --exact-target-seconds 0 \
+                    --probe-retries 1 --hard-timeout 500
 for n in 3 4 5 6 7 8 9; do
   sweep_pass "selfish_p$n" 1500 selfish-hashrate "$n" "$SH_OUT" artifacts/ck_sh_full
 done

@@ -91,3 +91,71 @@ def test_simulate_overlay_matches_oracle():
     want = analytical_stale_rates(hashrates, 10.0)
     for got, exp in zip(sim[10.0], want):
         assert abs(got - exp) < max(0.5 * exp, 0.004), (got, exp)
+
+
+def test_selfish_revenue_oracle_crossing():
+    from tpusim.analysis.oracle import selfish_relative_revenue as rev
+
+    # "Majority is not Enough" eq. 8 at gamma=0: revenue crosses hashrate
+    # exactly at alpha = 1/3; below it selfish mining loses money.
+    assert abs(rev(1 / 3) - 1 / 3) < 1e-12
+    assert rev(0.25) < 0.25 and rev(0.30) < 0.30
+    assert rev(0.35) > 0.35 and rev(0.45) > 0.45
+    # gamma=0.5 lowers the crossing (attacker wins some races for free).
+    assert rev(0.30, gamma=0.5) > rev(0.30, gamma=0.0)
+    with pytest.raises(ValueError):
+        rev(0.5)
+
+
+def test_selfish_crossing_plot_and_loader(tmp_path):
+    from tpusim.analysis.plots import load_selfish_grid_points, plot_selfish_crossing
+
+    rows = [
+        # max-runs preference: the 2^20 row must win over the smoke row.
+        {"runs": 1 << 20, "backend": "tpu",
+         "miners": [{"selfish": True, "hashrate_pct": 25,
+                     "blocks_share_mean": 0.156}]},
+        {"runs": 1 << 14, "backend": "tpu",
+         "miners": [{"selfish": True, "hashrate_pct": 25,
+                     "blocks_share_mean": 0.2}]},
+        {"runs": 1 << 20, "backend": "cpp",
+         "miners": [{"selfish": True, "hashrate_pct": 37,
+                     "blocks_share_mean": 0.3835}]},
+        # A selfish-threshold grid row (different block interval — a
+        # different experiment) must NOT leak into the crossing figure.
+        {"runs": 1 << 20, "backend": "cpp", "point": "interval-150s-selfish-35pct",
+         "miners": [{"selfish": True, "hashrate_pct": 35,
+                     "blocks_share_mean": 0.336}]},
+        # Valid JSON but truncated mid-schema: tolerated, not a crash.
+        {"miners": [{"selfish": True, "hashrate_pct": 25}]},
+        "not json at all",  # tolerated, like the sweep --resume scanner
+    ]
+    path = tmp_path / "sweep_selfish_hashrate_full_x.jsonl"
+    path.write_text("\n".join(
+        r if isinstance(r, str) else json.dumps(r) for r in rows) + "\n")
+    pts = load_selfish_grid_points([path])
+    assert {(p["backend"], p["selfish_hashrate_frac"], round(p["selfish_share"], 4))
+            for p in pts} == {("tpu", 0.25, 0.156), ("cpp", 0.37, 0.3835)}
+
+    png = tmp_path / "crossing.png"
+    plot_selfish_crossing(pts, out_path=png)
+    assert png.stat().st_size > 1000
+
+
+def test_plots_cli_selfish_grid(tmp_path):
+    from tpusim.analysis.plots import main
+
+    path = tmp_path / "grid.jsonl"
+    path.write_text(json.dumps(
+        {"runs": 64, "backend": "tpu",
+         "miners": [{"selfish": True, "hashrate_pct": 40,
+                     "blocks_share_mean": 0.46}]}) + "\n")
+    rc = main(["--out-dir", str(tmp_path), "--prop-hi-s", "20",
+               "--selfish-grid", str(path)])
+    assert rc == 0
+    assert (tmp_path / "selfish_crossing.png").exists()
+    # Empty/unusable grid files fail loudly instead of silently omitting
+    # the figure.
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert main(["--out-dir", str(tmp_path), "--selfish-grid", str(empty)]) == 2

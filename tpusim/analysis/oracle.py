@@ -97,3 +97,24 @@ def analytical_net_benefits(
         actual_share = h * (1.0 - r) / total_found
         out.append((actual_share - h) / h)
     return out
+
+
+def selfish_relative_revenue(alpha: float, gamma: float = 0.0) -> float:
+    """Eyal-Sirer ideal-model relative revenue of a selfish miner with
+    hashrate fraction ``alpha`` when honest miners join the attacker's fork
+    with probability ``gamma`` ("Majority is not Enough", 2013, eq. 8).
+
+    The reference implements the gamma=0 strategy (simulation.h:62-76,
+    149-174: never adopt a competing chain at equal length, publish only to
+    match or beat); this closed form is the zero-propagation-delay ideal of
+    that strategy, used as the analytical anchor for the full-scale
+    selfish-hashrate grid: revenue crosses alpha exactly at alpha = 1/3 when
+    gamma = 0, while the simulated crossing sits higher because propagation
+    delay costs the attacker reveal races the ideal model gives it for free.
+    """
+    if not 0.0 <= alpha < 0.5:
+        raise ValueError(f"alpha must be in [0, 0.5), got {alpha}")
+    a, g = alpha, gamma
+    num = a * (1 - a) ** 2 * (4 * a + g * (1 - 2 * a)) - a ** 3
+    den = 1 - a * (1 + (2 - a) * a)
+    return num / den

@@ -14,6 +14,8 @@ the reference.
 
 from __future__ import annotations
 
+import re
+import sys
 from pathlib import Path
 from typing import Sequence
 
@@ -118,6 +120,112 @@ def plot_benefits(
     return fig
 
 
+def plot_selfish_crossing(
+    points: Sequence[dict],
+    gamma: float = 0.0,
+    out_path: str | Path | None = None,
+    show: bool = False,
+):
+    """Selfish-miner block share vs hashrate: measured grid points against the
+    honest-income line and the Eyal-Sirer ideal curve (oracle docstring).
+
+    ``points`` are dicts with ``selfish_hashrate_frac``, ``selfish_share``,
+    and optionally ``backend``/``runs`` (the schema of
+    BASELINE.json ``published.full_scale_grids.selfish_hashrate`` rows and of
+    ``sweep_selfish_hashrate_*.jsonl`` after ``selfish_points`` extraction).
+    The simulated profitability crossing (share > hashrate) sits measurably
+    above the ideal 1/3 because propagation delay costs the attacker reveal
+    races; this figure is that result."""
+    import matplotlib
+
+    from .oracle import selfish_relative_revenue
+
+    if not show:
+        matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    xs = np.linspace(0.20, 0.495, 200)
+    fig, ax = plt.subplots(figsize=(8, 5.5))
+    ax.plot(xs, xs, color="black", linewidth=0.9, linestyle="--",
+            label="honest income (share = hashrate)")
+    ax.plot(xs, [selfish_relative_revenue(x, gamma) for x in xs],
+            color="tab:orange", linewidth=1.2,
+            label=f"Eyal-Sirer ideal, gamma={gamma:g} (crossing 1/3)")
+    by_backend: dict[str, list[tuple[float, float]]] = {}
+    for p in points:
+        by_backend.setdefault(p.get("backend", "sim"), []).append(
+            (p["selfish_hashrate_frac"], p["selfish_share"])
+        )
+    styles = {"tpu": ("o", "tab:blue"), "cpp": ("s", "tab:purple"),
+              "sim": ("^", "tab:gray")}
+    for backend, pts in sorted(by_backend.items()):
+        pts = sorted(pts)
+        marker, color = styles.get(backend, ("x", "tab:gray"))
+        ax.plot([x for x, _ in pts], [y for _, y in pts],
+                marker, color=color, markersize=6, linestyle=":",
+                label=f"measured ({backend})")
+    # Bracket the measured crossing from the point set itself.
+    below = [x for b in by_backend.values() for x, y in b if y <= x]
+    above = [x for b in by_backend.values() for x, y in b if y > x]
+    if below and above:
+        lo, hi = max(below), min(above)
+        ax.axvspan(lo, hi, alpha=0.15, color="tab:red",
+                   label=f"measured crossing ({lo * 100:.0f}%, {hi * 100:.0f}%)")
+    ax.set_xlabel("selfish hashrate fraction")
+    ax.set_ylabel("block share (relative revenue)")
+    ax.set_title("Selfish-mining profitability: simulated vs ideal model")
+    ax.legend(fontsize=8)
+    ax.grid(True, alpha=0.3)
+    if out_path is not None:
+        fig.savefig(out_path, dpi=120, bbox_inches="tight")
+    if show:
+        plt.show()
+    else:
+        plt.close(fig)
+    return fig
+
+
+def load_selfish_grid_points(paths: Sequence[str | Path]) -> list[dict]:
+    """Extract selfish-miner (hashrate, share) points from sweep JSONL rows
+    (the ``sweep_selfish_hashrate_*.jsonl`` schema); keeps the max-runs row
+    per (backend, hashrate)."""
+    import json
+
+    best: dict[tuple[str, int], dict] = {}
+    for path in paths:
+        path = Path(path)
+        backend = "cpp" if "native" in path.name or "cpp" in path.name else "tpu"
+        for line in path.read_text().splitlines():
+            if not line.strip():
+                continue
+            try:
+                r = json.loads(line)
+                m0 = r["miners"][0]
+                if not m0.get("selfish"):
+                    continue
+                # Named rows from other selfish grids (e.g. the
+                # block-interval x threshold sweep's interval-150s-* points)
+                # are a different experiment — mixing them in would shift
+                # the rendered crossing band. Unnamed rows (the pre-naming
+                # full-scale native artifact) are hashrate-grid by schema.
+                name = r.get("point")
+                if name is not None and not re.fullmatch(r"selfish-\d+pct", name):
+                    continue
+                backend_r = r.get("backend", backend)
+                key = (backend_r, m0["hashrate_pct"])
+                if key in best and best[key]["runs"] >= r["runs"]:
+                    continue
+                best[key] = {
+                    "selfish_hashrate_frac": m0["hashrate_pct"] / 100.0,
+                    "selfish_share": m0["blocks_share_mean"],
+                    "backend": backend_r,
+                    "runs": r["runs"],
+                }
+            except (ValueError, KeyError, IndexError, TypeError):
+                continue
+    return list(best.values())
+
+
 def simulate_overlay(
     hashrates: Sequence[float],
     props_s: Sequence[float],
@@ -168,6 +276,14 @@ def main(argv: list[str] | None = None) -> int:
         metavar="RUNS",
         help="overlay simulated stale rates at a few propagation values (runs per point)",
     )
+    p.add_argument(
+        "--selfish-grid",
+        type=Path,
+        nargs="+",
+        metavar="JSONL",
+        help="sweep_selfish_hashrate_*.jsonl files; adds the selfish-crossing "
+        "figure (measured share-vs-hashrate against the Eyal-Sirer ideal)",
+    )
     args = p.parse_args(argv)
 
     simulated = None
@@ -194,8 +310,25 @@ def main(argv: list[str] | None = None) -> int:
         out_path=out2,
         show=args.show,
     )
+    written = [out1, out2]
+    if args.selfish_grid:
+        missing = [p for p in args.selfish_grid if not p.exists()]
+        if missing:
+            print(
+                "selfish-grid file(s) not found: "
+                + " ".join(str(p) for p in missing),
+                file=sys.stderr,
+            )
+            return 2
+        pts = load_selfish_grid_points(args.selfish_grid)
+        if not pts:
+            print("no selfish points found in the given files", file=sys.stderr)
+            return 2
+        out3 = None if args.show else args.out_dir / "selfish_crossing.png"
+        plot_selfish_crossing(pts, out_path=out3, show=args.show)
+        written.append(out3)
     if not args.show:
-        print(f"wrote {out1} and {out2}")
+        print("wrote " + " ".join(str(w) for w in written))
     return 0
 
 
