@@ -75,7 +75,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--group-slots", type=int, default=None,
         help="in-flight arrival-group buffer slots per (run, miner); "
-        "default auto (2 fast / 4 exact). Part of the sampling identity.",
+        "default auto (2 in both modes; 4 reproduces pre-round-5 exact "
+        "configs). Part of the sampling identity.",
     )
     p.add_argument(
         "--chunk-steps", type=int, default=None,
