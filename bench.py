@@ -91,6 +91,26 @@ class _Watchdog(Exception):
     pass
 
 
+def append_perf_rows(rows: list[dict], measurement: str) -> None:
+    """Append on-chip measurement rows to the perf log, stamping date/chip.
+    Callers must only pass hardware measurements — cached_tpu_numbers()
+    serves this file as the on-chip story whenever a bench run falls back
+    to CPU."""
+    import jax
+
+    try:
+        with open(PERF_LOG, "a") as f:
+            for row in rows:
+                f.write(json.dumps({
+                    "date": time.strftime("%Y-%m-%d"),
+                    "chip": str(jax.devices()[0]),
+                    "measurement": measurement,
+                    **row,
+                }) + "\n")
+    except OSError as e:
+        log(f"could not append rows to {PERF_LOG}: {e}")
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch-size", type=int, default=0, help="runs per jitted batch (0 = auto)")
@@ -264,17 +284,8 @@ def main() -> int:
             # cached_tpu_numbers() reads this file and must only ever see
             # hardware measurements.
             if platform == "tpu":
-                try:
-                    with open(PERF_LOG, "a") as f:
-                        for tag, row in results.items():
-                            f.write(json.dumps({
-                                "date": time.strftime("%Y-%m-%d"),
-                                "chip": str(jax.devices()[0]),
-                                "measurement": f"bench.py --ablate {tag}",
-                                **row,
-                            }) + "\n")
-                except OSError as e:
-                    log(f"could not append ablation rows to {PERF_LOG}: {e}")
+                for tag, row in results.items():
+                    append_perf_rows([row], f"bench.py --ablate {tag}")
             signal.alarm(0)
             done.set()
             first = next(iter(results.values()))
@@ -458,6 +469,39 @@ def main() -> int:
             cached = cached_tpu_numbers()
             if cached is not None:
                 payload["cached_tpu"] = cached
+        else:
+            # Self-record the end-to-end headlines in the perf log (standard
+            # schema: mode + sim_years_per_s), so a later CPU fallback's
+            # cached_tpu serves the latest driver-format numbers rather than
+            # only --ablate kernel rates. Gated to representative runs: the
+            # kernel engine (a forced/fallback scan run or a truncated
+            # budget must not overwrite the cached on-chip story with a
+            # degraded number).
+            rows = []
+            if info["engine"] == "pallas" and elapsed >= 10.0:
+                rows.append({
+                    "engine": info["engine"],
+                    "mode": "fast",
+                    "config": f"9-miner honest, 1s prop, "
+                              f"{total_runs} runs x 365d",
+                    "sim_years_per_s": round(sim_years_per_s, 3),
+                    "vs_cpu_core_baseline": payload["vs_baseline"],
+                })
+            einfo = info.get("exact", {})
+            if einfo.get("engine") == "pallas" and \
+                    einfo.get("elapsed_s", 0.0) >= 10.0:
+                rows.append({
+                    "engine": einfo["engine"],
+                    "mode": "exact",
+                    "config": f"40% selfish gamma=0, 1s prop, "
+                              f"{einfo['runs']} runs x 365d",
+                    "sim_years_per_s": einfo["sim_years_per_s"],
+                    "vs_cpu_core_baseline": einfo["vs_baseline"],
+                })
+            if rows:
+                append_perf_rows(
+                    rows, "bench.py end-to-end headline (incl. dispatch)"
+                )
         done.set()
         emit_once(payload)
         return 0
