@@ -28,11 +28,11 @@ run_step() {
   fi
 }
 
-sweep_pass() {  # sweep_pass <name> <timeout> <grid> <max-points> <out> <ckdir> [extra...]
-  local name="$1" to="$2" grid="$3" n="$4" out="$5" ck="$6"; shift 6
+sweep_pass() {  # sweep_pass <name> <timeout> <grid> <max-points> <out> <ckdir> [runs-scale]
+  local name="$1" to="$2" grid="$3" n="$4" out="$5" ck="$6" scale="${7:-1.0}"
   run_step "$name" timeout -k 10 "$to" python -m tpusim.sweep "$grid" \
-    --runs-scale 1.0 --max-points "$n" --resume \
-    --out "$out" --checkpoint-dir "$ck" --quiet "$@"
+    --runs-scale "$scale" --max-points "$n" --resume \
+    --out "$out" --checkpoint-dir "$ck" --quiet
 }
 
 SH_OUT=artifacts/sweep_selfish_hashrate_full_r5.jsonl
@@ -41,6 +41,12 @@ PR_OUT=artifacts/sweep_propagation_full_r5.jsonl
 sweep_pass selfish_p2 1500 selfish-hashrate 2 "$SH_OUT" artifacts/ck_sh_full
 sweep_pass prop_p1    1200 propagation      1 "$PR_OUT" artifacts/ck_prop_full
 sweep_pass prop_p2    1200 propagation      2 "$PR_OUT" artifacts/ck_prop_full
+# Re-prove the reference tables on-chip under the round-5 exact default
+# (group_slots auto=2; the committed prop10s/prop100ms/selfish40 TPU rows
+# predate the flip). ~40-60 s each incl. compile.
+run_step refsc_selfish40 timeout -k 10 900 python scripts/refscale.py --backend tpu --config selfish40
+run_step refsc_prop10s   timeout -k 10 900 python scripts/refscale.py --backend tpu --config prop10s
+run_step refsc_prop100ms timeout -k 10 900 python scripts/refscale.py --backend tpu --config prop100ms
 run_step micro      timeout -k 10 1200 python scripts/mosaic_micro.py --iters 4096
 run_step exactsweep timeout -k 10 2400 python scripts/tpu_exact_sweep.py --runs 2048 --n-chunks 12
 run_step tracefast  timeout -k 10 900 python -m tpusim --runs 8192 --days 30 \
@@ -68,4 +74,11 @@ run_step hetero32 timeout -k 10 5400 python -m tpusim.sweep hetero32 \
   --runs-scale 0.25 --resume \
   --out artifacts/sweep_hetero32_2e20_r5.jsonl \
   --checkpoint-dir artifacts/ck_h32 --quiet
+# configs[4] (block-interval x selfish-threshold) at 2^17 runs/point on the
+# TPU engine — 40x the committed cpp smoke evidence; stepped and resumable
+# like the other grids (15 points, ~2 min each at exact-mode rate).
+for n in 3 6 9 12 15; do
+  sweep_pass "threshold_p$n" 2400 selfish-threshold "$n" \
+    artifacts/sweep_selfish_threshold_2e17_r5.jsonl artifacts/ck_th 0.0078125
+done
 echo "=== plan complete; see $LOG" | tee -a "$LOG/plan.log"
