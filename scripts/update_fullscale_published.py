@@ -46,6 +46,7 @@ def selfish_points(path: Path, backend: str) -> dict[str, dict]:
             "elapsed_s": round(r["elapsed_s"], 1),
             "selfish_share": round(m0["blocks_share_mean"], 5),
             "_share_raw": m0["blocks_share_mean"],
+            "_chain_blocks": r.get("best_height_mean"),
             "selfish_hashrate_frac": m0["hashrate_pct"] / 100.0,
             "profitable": m0["blocks_share_mean"] > m0["hashrate_pct"] / 100.0,
         }
@@ -81,15 +82,29 @@ def main() -> int:
             # Same point at the same full scale on both backends: publish the
             # TPU row annotated with the independent native share — two
             # 2^20-run estimates agreeing is the cross-validation story. The
-            # diff comes from the unrounded means so its last digit is real.
-            tpu["selfish_share_native"] = prior["selfish_share"]
-            tpu["share_abs_diff_vs_native"] = round(
-                abs(tpu["_share_raw"] - prior["_share_raw"]), 7
+            # diff comes from the unrounded means so its last digit is real,
+            # and it is scored against the Monte-Carlo envelope of two
+            # independent estimates: per-run share variance ≈ s(1-s)/chain,
+            # where chain is the run's actual main-chain length (the
+            # artifact's best_height_mean — materially below the ideal
+            # 600 s-interval count under selfish staling), σ_mean =
+            # σ_run/√runs, σ_diff = √2·σ_mean.
+            s = tpu["_share_raw"]
+            blocks_per_run = (
+                tpu.get("_chain_blocks")
+                or prior.get("_chain_blocks")
+                or 365.2425 * 86400 / 600.0
             )
+            sigma_diff = (2 * s * (1 - s) / blocks_per_run) ** 0.5 / tpu["runs"] ** 0.5
+            diff = abs(s - prior["_share_raw"])
+            tpu["selfish_share_native"] = prior["selfish_share"]
+            tpu["share_abs_diff_vs_native"] = round(diff, 7)
+            tpu["share_diff_in_sigma_units"] = round(diff / sigma_diff, 2)
             tpu["native_elapsed_s"] = prior["elapsed_s"]
         pts[name] = tpu
     for p in pts.values():
         p.pop("_share_raw", None)
+        p.pop("_chain_blocks", None)
     bracket = crossing_bracket(pts)
 
     grids: dict = {
