@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# After any TPU window (tpu_r5*_plan.sh run), fold the new artifacts into
+# the published evidence in one deterministic pass:
+#   * BASELINE.json published.configs        <- refscale_report.py
+#   * BASELINE.json published.full_scale_grids <- update_fullscale_published.py
+#   * REFSCALE.md                            <- refscale_report.py
+#   * artifacts/plots/selfish_crossing.png   <- tpusim.analysis --selfish-grid
+# Everything re-derives from committed artifact files, so running this twice
+# is a no-op. Review `git diff` and commit afterwards.
+set -eu
+cd "$(dirname "$0")/.."
+python scripts/update_fullscale_published.py
+python scripts/refscale_report.py
+grids=(artifacts/sweep_selfish_hashrate_full_native.jsonl
+       artifacts/sweep_selfish_hashrate_full_r5.jsonl
+       artifacts/sweep_selfish_hashrate_scale0.015625.jsonl)
+existing=()
+for g in "${grids[@]}"; do [ -f "$g" ] && existing+=("$g"); done
+if [ "${#existing[@]}" -gt 0 ]; then
+  # --only-selfish-grid: the committed stale_rates.png carries a --simulate
+  # overlay this script must not silently strip.
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m tpusim.analysis --out-dir artifacts/plots --only-selfish-grid \
+    --selfish-grid "${existing[@]}"
+fi
+git status --short BASELINE.json REFSCALE.md artifacts/

@@ -284,33 +284,43 @@ def main(argv: list[str] | None = None) -> int:
         help="sweep_selfish_hashrate_*.jsonl files; adds the selfish-crossing "
         "figure (measured share-vs-hashrate against the Eyal-Sirer ideal)",
     )
+    p.add_argument(
+        "--only-selfish-grid",
+        action="store_true",
+        help="write only the selfish-crossing figure — regeneration scripts "
+        "must not silently rewrite the propagation figures (whose committed "
+        "versions carry a --simulate overlay) as a side effect",
+    )
     args = p.parse_args(argv)
+    if args.only_selfish_grid and not args.selfish_grid:
+        p.error("--only-selfish-grid requires --selfish-grid")
 
-    simulated = None
-    if args.simulate:
-        props = [1.0, 10.0, 30.0, 60.0]
-        simulated = simulate_overlay(DEFAULT_POOLS, props, runs=args.simulate)
-    out1 = out2 = None
     if not args.show:
         args.out_dir.mkdir(parents=True, exist_ok=True)
-        out1 = args.out_dir / "stale_rates.png"
-        out2 = args.out_dir / "net_benefits.png"
-    plot_stale_rates(
-        prop_lo_s=args.prop_lo_s,
-        prop_hi_s=args.prop_hi_s,
-        block_interval_s=args.block_interval_s,
-        simulated=simulated,
-        out_path=out1,
-        show=args.show,
-    )
-    plot_benefits(
-        prop_lo_s=args.prop_lo_s,
-        prop_hi_s=args.prop_hi_s,
-        block_interval_s=args.block_interval_s,
-        out_path=out2,
-        show=args.show,
-    )
-    written = [out1, out2]
+    written = []
+    if not args.only_selfish_grid:
+        simulated = None
+        if args.simulate:
+            props = [1.0, 10.0, 30.0, 60.0]
+            simulated = simulate_overlay(DEFAULT_POOLS, props, runs=args.simulate)
+        out1 = None if args.show else args.out_dir / "stale_rates.png"
+        out2 = None if args.show else args.out_dir / "net_benefits.png"
+        plot_stale_rates(
+            prop_lo_s=args.prop_lo_s,
+            prop_hi_s=args.prop_hi_s,
+            block_interval_s=args.block_interval_s,
+            simulated=simulated,
+            out_path=out1,
+            show=args.show,
+        )
+        plot_benefits(
+            prop_lo_s=args.prop_lo_s,
+            prop_hi_s=args.prop_hi_s,
+            block_interval_s=args.block_interval_s,
+            out_path=out2,
+            show=args.show,
+        )
+        written += [out1, out2]
     if args.selfish_grid:
         missing = [p for p in args.selfish_grid if not p.exists()]
         if missing:
