@@ -17,6 +17,11 @@ set -u
 LOG="${1:-artifacts/r5d_tpu_logs}"
 cd "$(dirname "$0")/.."
 mkdir -p "$LOG"
+# Persistent XLA compilation cache: every pass is a fresh process and the
+# year-long engines take 15-40 s to compile; across the plan's ~25 steps
+# this is many window-minutes. Harmless no-op if the remote backend
+# bypasses it.
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$PWD/.jax_cache}"
 
 run_step() {
   local name="$1"; shift
