@@ -23,11 +23,21 @@ mkdir -p "$LOG"
 # bypasses it.
 export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$PWD/.jax_cache}"
 
+# Steps mark completion in $LOG/done.<name>; a re-run of the plan (the
+# looping watcher re-launches it every tunnel-up window) skips completed
+# steps instantly, so short windows accumulate instead of re-treading.
+# Sweep passes additionally self-resume via --resume + checkpoints even
+# when interrupted mid-step.
 run_step() {
   local name="$1"; shift
+  if [ -f "$LOG/done.$name" ]; then
+    echo "=== $name already done; skipping" | tee -a "$LOG/plan.log"
+    return 0
+  fi
   echo "=== [$(date -u +%H:%M:%S)] $name: $*" | tee -a "$LOG/plan.log"
   if "$@" >"$LOG/$name.out" 2>"$LOG/$name.err"; then
     echo "=== $name OK" | tee -a "$LOG/plan.log"
+    touch "$LOG/done.$name"
   else
     echo "=== $name FAILED rc=$? (continuing)" | tee -a "$LOG/plan.log"
   fi

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Looping TPU tunnel watcher: probes every ~7 min and re-launches the given
+# playbook on EVERY tunnel-up probe (not one-shot like tpu_watch.sh) — the
+# playbook must make re-runs cheap (tpu_r5d_plan.sh: done-markers per step +
+# --resume sweeps), so each short window resumes exactly where the last one
+# died. A run is started at most once per probe cycle and never concurrently.
+#
+#   setsid nohup bash scripts/tpu_watch_loop.sh scripts/tpu_r5d_plan.sh >/dev/null 2>&1 &
+#
+# Log: /tmp/tpu_watch.log. Stop: touch /tmp/tpu_watch_stop.
+cd "$(dirname "$0")/.."
+PLAN="${1:-scripts/tpu_r5d_plan.sh}"
+while true; do
+  [ -f /tmp/tpu_watch_stop ] && { echo "$(date -u +%FT%TZ) stop requested" >> /tmp/tpu_watch.log; exit 0; }
+  if timeout -k 5 150 python -c "import jax; assert jax.devices()[0].platform=='tpu'" >/dev/null 2>&1; then
+    echo "$(date -u +%FT%TZ) TPU UP; running $PLAN" >> /tmp/tpu_watch.log
+    bash "$PLAN" >> /tmp/tpu_watch.log 2>&1
+    echo "$(date -u +%FT%TZ) $PLAN pass finished" >> /tmp/tpu_watch.log
+  else
+    echo "$(date -u +%FT%TZ) tpu down" >> /tmp/tpu_watch.log
+  fi
+  sleep 420
+done
