@@ -16,11 +16,21 @@ grids=(artifacts/sweep_selfish_hashrate_full_native.jsonl
        artifacts/sweep_selfish_hashrate_scale0.015625.jsonl)
 existing=()
 for g in "${grids[@]}"; do [ -f "$g" ] && existing+=("$g"); done
-if [ "${#existing[@]}" -gt 0 ]; then
-  # --only-selfish-grid: the committed stale_rates.png carries a --simulate
-  # overlay this script must not silently strip.
+# --only-selfish-grid suppresses the propagation figures (the committed
+# stale_rates.png carries a --simulate overlay this script must not
+# silently strip); the crossing and hetero-validation figures regenerate
+# independently, each from whichever of its inputs exist. The hetero one
+# prefers the full-scale TPU artifact once a window produces it.
+selfish=()
+[ "${#existing[@]}" -gt 0 ] && selfish=(--selfish-grid "${existing[@]}")
+hetero=()
+for h in artifacts/sweep_hetero32_2e20_r5.jsonl \
+         artifacts/sweep_hetero32_cpp_scale0.0039.jsonl; do
+  [ -f "$h" ] && { hetero=(--hetero-grid "$h"); break; }
+done
+if [ "${#selfish[@]}" -gt 0 ] || [ "${#hetero[@]}" -gt 0 ]; then
   env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python -m tpusim.analysis --out-dir artifacts/plots --only-selfish-grid \
-    --selfish-grid "${existing[@]}"
+    "${selfish[@]}" "${hetero[@]}"
 fi
 git status --short BASELINE.json REFSCALE.md artifacts/

@@ -51,30 +51,42 @@ def analytical_stale_rates(
 ) -> list[float]:
     """Per-miner stale rates for an honest network (reference plot.py:40-56).
 
-    ``prop_s`` may be one propagation time (seconds) for all miners or one per
-    miner. A block of miner ``i`` goes stale "before" when a competitor ``j``
-    found a block that was still inside *j's* propagation window when ours
-    appeared (and someone else finds the next block), and "after" when ``j``
-    finds a competing block inside *our* window and then also finds the next
-    one. With homogeneous propagation the "before" term collapses to the
-    reference's lumped rest-of-network formula (plot.py:28-33), reproduced
-    exactly in that case.
+    ``prop_s`` may be one propagation time (seconds) for all miners or one
+    per miner. In the reference's propagation model a block found by ``j``
+    at ``t0`` reaches every other miner at ``t0 + prop_j`` (simulation.h
+    arrival semantics), so working a same-height race between blocks of
+    ``i`` (found ``t1``) and ``j`` through the first-seen tiebreak gives two
+    loss channels for ``i``, each with a window set by exactly one miner's
+    propagation:
+
+    * **j's block arrives first** — the find-time windows where
+      ``t0 + prop_j < t1 + prop_i`` total exactly ``prop_i`` (found-before
+      slot ``min(prop_i, prop_j)`` plus found-after slot
+      ``max(0, prop_i - prop_j)``): every third party first-sees ``j``'s
+      block, and ``i``'s block survives only if ``i`` finds the next block —
+      stale with factor ``(1 - h_i)``. Lumping the rest of the network as
+      one ``1 - h_i`` process, this is the reference's ``p_stale_before``
+      evaluated at *our own* ``prop_i``.
+    * **i's block arrives first** — the complementary windows total
+      ``prop_j``: ``j`` alone is on its own branch and ``i``'s block goes
+      stale only if ``j`` also finds the next block — factor ``h_j``,
+      window *j's own* ``prop_j``.
+
+    With homogeneous propagation both reduce exactly to the reference's
+    formulas (plot.py:28-38). The heterogeneous form is validated against
+    the simulated 32-miner log-spaced roster (tests/test_profiling_plots.py,
+    artifacts/plots/hetero32_validation.png): a miner's stale rate rides its
+    own propagation (the r5 pre-fix form summed competitors' windows, which
+    predicted a near-uniform ~0.6 % where the simulation spans
+    0.02 %-10 %).
     """
     n = len(hashrates)
     props = [float(prop_s)] * n if isinstance(prop_s, (int, float)) else [float(p) for p in prop_s]
-    homogeneous = all(p == props[0] for p in props)
     rates = []
     for i, h in enumerate(hashrates):
-        if homogeneous:
-            before = p_stale_before(props[i], h, block_interval_s)
-        else:
-            before = sum(
-                _p_finds_within(props[j], hashrates[j], block_interval_s)
-                for j in range(n)
-                if j != i
-            ) * (1.0 - h)
+        before = p_stale_before(props[i], h, block_interval_s)
         after = sum(
-            _p_finds_within(props[i], hashrates[j], block_interval_s) * hashrates[j]
+            _p_finds_within(props[j], hashrates[j], block_interval_s) * hashrates[j]
             for j in range(n)
             if j != i
         )

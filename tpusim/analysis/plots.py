@@ -185,6 +185,64 @@ def plot_selfish_crossing(
     return fig
 
 
+def plot_hetero_validation(
+    hashrates: Sequence[float],
+    props_ms: Sequence[float],
+    measured: Sequence[float],
+    runs: int,
+    backend: str = "cpp",
+    block_interval_s: float = 600.0,
+    out_path: str | Path | None = None,
+    show: bool = False,
+):
+    """Heterogeneous-propagation centralization pressure: per-miner measured
+    stale rate vs the closed-form oracle, over each miner's own propagation
+    time (marker area ~ hashrate).
+
+    The reference's oracle (plot_stale_rate/plot.py) assumes one propagation
+    time for the whole network; tpusim.analysis.oracle generalizes it to
+    per-miner values, and this figure validates that generalization against
+    the simulated 32-miner log-spaced roster (BASELINE configs[3]) — the
+    centralization gradient (fast big miners near-zero stale, slow 1 %
+    miners ~10 %) on one chart."""
+    import matplotlib
+
+    from .oracle import analytical_stale_rates
+
+    if not show:
+        matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    props_s = [p / 1000.0 for p in props_ms]
+    oracle = analytical_stale_rates(list(hashrates), props_s, block_interval_s)
+    order = np.argsort(props_s)
+    fig, ax = plt.subplots(figsize=(8.5, 5.5))
+    ax.plot(
+        [props_s[i] for i in order], [oracle[i] * 100 for i in order],
+        color="tab:orange", linewidth=1.2, label="closed-form oracle",
+    )
+    sizes = [2000.0 * h for h in hashrates]
+    ax.scatter(
+        props_s, [m * 100 for m in measured], s=sizes, alpha=0.6,
+        color="tab:blue", edgecolors="black", linewidths=0.4,
+        label=f"simulated ({backend}, {runs} runs; area = hashrate)",
+    )
+    ax.set_xscale("log")
+    ax.set_yscale("log")
+    ax.set_xlabel("miner's block propagation time (s)")
+    ax.set_ylabel("stale rate (%)")
+    ax.set_title("Centralization pressure, 32-miner heterogeneous propagation")
+    ax.legend(fontsize=8)
+    ax.grid(True, alpha=0.3, which="both")
+    if out_path is not None:
+        fig.savefig(out_path, dpi=120, bbox_inches="tight")
+    if show:
+        plt.show()
+    else:
+        plt.close(fig)
+    return fig
+
+
 def load_selfish_grid_points(paths: Sequence[str | Path]) -> list[dict]:
     """Extract selfish-miner (hashrate, share) points from sweep JSONL rows
     (the ``sweep_selfish_hashrate_*.jsonl`` schema); keeps the max-runs row
@@ -285,15 +343,25 @@ def main(argv: list[str] | None = None) -> int:
         "figure (measured share-vs-hashrate against the Eyal-Sirer ideal)",
     )
     p.add_argument(
+        "--hetero-grid",
+        type=Path,
+        metavar="JSONL",
+        help="a sweep_hetero32_*.jsonl file; adds the heterogeneous-"
+        "propagation validation figure (measured per-miner stale rates vs "
+        "the generalized oracle; roster from the hetero32 grid definition)",
+    )
+    p.add_argument(
         "--only-selfish-grid",
         action="store_true",
-        help="write only the selfish-crossing figure — regeneration scripts "
-        "must not silently rewrite the propagation figures (whose committed "
-        "versions carry a --simulate overlay) as a side effect",
+        help="suppress the propagation figures (stale_rates/net_benefits) "
+        "and write only the artifact-derived ones (--selfish-grid and/or "
+        "--hetero-grid) — regeneration scripts must not silently rewrite "
+        "the propagation figures, whose committed versions carry a "
+        "--simulate overlay",
     )
     args = p.parse_args(argv)
-    if args.only_selfish_grid and not args.selfish_grid:
-        p.error("--only-selfish-grid requires --selfish-grid")
+    if args.only_selfish_grid and not (args.selfish_grid or args.hetero_grid):
+        p.error("--only-selfish-grid requires --selfish-grid or --hetero-grid")
 
     if not args.show:
         args.out_dir.mkdir(parents=True, exist_ok=True)
@@ -337,6 +405,45 @@ def main(argv: list[str] | None = None) -> int:
         out3 = None if args.show else args.out_dir / "selfish_crossing.png"
         plot_selfish_crossing(pts, out_path=out3, show=args.show)
         written.append(out3)
+    if args.hetero_grid:
+        if not args.hetero_grid.exists():
+            print(f"hetero-grid file not found: {args.hetero_grid}", file=sys.stderr)
+            return 2
+        import json
+
+        from ..sweep import baseline_sweeps
+
+        row = None
+        for line in args.hetero_grid.read_text().splitlines():
+            if not line.strip():
+                continue
+            try:
+                r = json.loads(line)
+            except ValueError:
+                continue
+            if r.get("point") == "hetero32" and (
+                row is None or r["runs"] > row["runs"]
+            ):
+                row = r
+        if row is None:
+            print(f"no hetero32 row in {args.hetero_grid}", file=sys.stderr)
+            return 2
+        # The artifact rows don't carry per-miner propagation; the grid
+        # definition is the authority for the roster.
+        (_, cfg), = baseline_sweeps()["hetero32"]()
+        miners = cfg.network.miners
+        out4 = None if args.show else args.out_dir / "hetero32_validation.png"
+        plot_hetero_validation(
+            hashrates=[m.hashrate_pct / 100.0 for m in miners],
+            props_ms=[m.propagation_ms for m in miners],
+            measured=[m["stale_rate_mean"] for m in row["miners"]],
+            runs=row["runs"],
+            backend=row.get("backend", "?"),
+            block_interval_s=cfg.network.block_interval_s,
+            out_path=out4,
+            show=args.show,
+        )
+        written.append(out4)
     if not args.show:
         print("wrote " + " ".join(str(w) for w in written))
     return 0
