@@ -111,6 +111,34 @@ def append_perf_rows(rows: list[dict], measurement: str) -> None:
         log(f"could not append rows to {PERF_LOG}: {e}")
 
 
+def pipelined_measure(engine, key_fn, batch: int, budget_s: float,
+                      max_batches: int, depth: int) -> tuple[int, float]:
+    """Depth-``depth`` pipelined measure loop: dispatch batch i+1 (keys from
+    ``key_fn(i)``), then finalize batches until at most ``depth`` remain in
+    flight, so host-side key construction and stat reduction overlap device
+    compute. The budget is checked after each dispatch round and the final
+    drain is included in the measured wall time. Returns (total_runs,
+    elapsed_s); depth 0 is the sequential (non-pipelined) loop. The wall
+    time can overshoot the budget by up to ``depth + 1`` batch durations
+    (the batch whose finalize reveals the budget is spent, plus the ones
+    already in flight behind it) — size the batch to the budget on slow
+    hosts; the --hard-timeout watchdog bounds the worst case."""
+    total_runs = 0
+    inflight: list = []
+    t0 = time.perf_counter()
+    for i in range(max_batches):
+        inflight.append(engine.run_batch_async(key_fn(i)))
+        while len(inflight) > depth:
+            inflight.pop(0)()
+            total_runs += batch
+        if time.perf_counter() - t0 >= budget_s:
+            break
+    while inflight:
+        inflight.pop(0)()
+        total_runs += batch
+    return total_runs, time.perf_counter() - t0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch-size", type=int, default=0, help="runs per jitted batch (0 = auto)")
@@ -125,10 +153,21 @@ def main() -> int:
     ap.add_argument("--exact-target-seconds", type=float, default=20.0,
                     help="measurement budget for the exact-mode (selfish) "
                          "headline; 0 skips it")
+    ap.add_argument("--superstep", type=int, default=0,
+                    help="events unrolled per device-loop iteration "
+                         "(0 = engine auto default); bit-identical results "
+                         "for every value")
+    ap.add_argument("--no-pipeline", action="store_true",
+                    help="finalize each batch before dispatching the next "
+                         "(the pre-pipelining measure loop, for ablation)")
     ap.add_argument("--ablate", type=int, default=0, metavar="N_CHUNKS",
                     help="instead of the headline, time N>=12 chained chunks "
                          "inside one jit per engine (the canonical "
                          "kernel-timing discipline) and emit us/step")
+    # Test hook: block forever right after backend init so the watchdog path
+    # can be exercised deterministically (tests/test_bench.py) instead of
+    # racing a real compile against the timeout.
+    ap.add_argument("--hang-for-test", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
 
     phase = "backend-init"
@@ -230,6 +269,11 @@ def main() -> int:
 
         platform = jax.devices()[0].platform
         info["platform"] = platform
+
+        if args.hang_for_test:
+            phase = "hang-for-test"
+            while True:  # interruptible sleep: SIGALRM must be deliverable
+                time.sleep(0.2)
 
         from tpusim import SimConfig, default_network, DEFAULT_DURATION_MS
         from tpusim.engine import Engine
@@ -342,7 +386,11 @@ def main() -> int:
         if args.batch_size:
             batch = args.batch_size
         elif platform == "cpu":
-            batch = 64  # a 365d batch at CPU scan-engine speed must stay in budget
+            # 512 amortizes the tiny-op overhead of 2-core CPU XLA far
+            # better than the historical 64 (measured ~1.3x steady-state,
+            # scripts/roofline.py batch ablation) and is exactly one
+            # headline batch: 512 runs x 365 d.
+            batch = 512
         else:
             batch = 8192
             if smoke_rate is not None:
@@ -362,9 +410,12 @@ def main() -> int:
             runs=batch,
             batch_size=batch,
             seed=7,
+            superstep=args.superstep or None,
         )
         engine = build_engine(config)
         info["engine"] = "pallas" if isinstance(engine, PallasEngine) else "scan"
+        info["superstep"] = engine.superstep
+        info["pipelined"] = not args.no_pipeline
 
         phase = "headline-compile"
         # Compile + warm up (first TPU compile is slow and must not be timed).
@@ -384,14 +435,16 @@ def main() -> int:
         log(f"warm-up done in {info['warmup_s']}s")
 
         phase = "measure"
-        total_runs = 0
-        t0 = time.perf_counter()
-        for i in range(args.max_batches):
-            engine.run_batch(make_run_keys(config.seed, (i + 1) * batch, batch))
-            total_runs += batch
-            if time.perf_counter() - t0 >= args.target_seconds:
-                break
-        elapsed = time.perf_counter() - t0
+        # Pipelined measure loop: batch i+1 is dispatched before batch i is
+        # finalized (one batch in flight), so host-side key construction and
+        # stat reduction overlap device compute — the measured rate is the
+        # sustained driver rate, directly comparable to the kernel-rate
+        # ablation. --no-pipeline restores the sequential loop.
+        depth = 0 if args.no_pipeline else 1
+        total_runs, elapsed = pipelined_measure(
+            engine, lambda i: make_run_keys(config.seed, (i + 1) * batch, batch),
+            batch, args.target_seconds, args.max_batches, depth,
+        )
         sim_years_per_s = total_runs * years_per_run / elapsed
 
         def headline_payload() -> dict:
@@ -427,12 +480,15 @@ def main() -> int:
             exact_cfg = SimConfig(
                 network=SELFISH_NET, duration_ms=DEFAULT_DURATION_MS,
                 runs=ebatch, batch_size=ebatch, seed=7,
+                superstep=args.superstep or None,
             )
             eng2 = build_engine(exact_cfg)
             einfo: dict = {
                 "engine": "pallas" if isinstance(eng2, PallasEngine) else "scan",
                 "batch_size": ebatch,
                 "mode": exact_cfg.resolved_mode,
+                "superstep": eng2.superstep,
+                "pipelined": not args.no_pipeline,
             }
             t0 = time.monotonic()
             try:
@@ -445,14 +501,10 @@ def main() -> int:
                 einfo["engine"] = "scan (pallas fallback)"
                 eng2.run_batch(make_run_keys(7, 0, ebatch))
             einfo["warmup_s"] = round(time.monotonic() - t0, 2)
-            total2 = 0
-            t0 = time.perf_counter()
-            for i in range(args.max_batches):
-                eng2.run_batch(make_run_keys(7, (i + 1) * ebatch, ebatch))
-                total2 += ebatch
-                if time.perf_counter() - t0 >= args.exact_target_seconds:
-                    break
-            e_elapsed = time.perf_counter() - t0
+            total2, e_elapsed = pipelined_measure(
+                eng2, lambda i: make_run_keys(7, (i + 1) * ebatch, ebatch),
+                ebatch, args.exact_target_seconds, args.max_batches, depth,
+            )
             e_rate = total2 * years_per_run / e_elapsed
             einfo.update(
                 runs=total2,

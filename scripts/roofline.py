@@ -1,0 +1,290 @@
+"""Roofline + superstep/batch ablation harness.
+
+Measures events/s for the scan engine (and the Pallas kernel when a real TPU
+is attached) with the chained-chunk timing discipline
+(tpusim.profiling.time_chained_chunks), derives the memory-bandwidth-bound
+event rate from the engines' traffic models (tpusim.profiling.bytes_per_event)
+against a STREAM-style measured copy bandwidth, and emits:
+
+  * one machine-readable JSON document (--out, default
+    artifacts/roofline_<platform>.json) with every measured point and the
+    bandwidth measurement, and
+  * an optional committed markdown report (--md ROOFLINE.md) stating how far
+    each engine sits from its bandwidth roof plus the K x batch ablation
+    table.
+
+When the harness runs on CPU, the Pallas side of the report falls back to the
+last builder-measured on-chip rates in artifacts/perf_tpu.jsonl (the same
+cache bench.py serves when the TPU tunnel is down) against the v5e HBM
+datasheet bandwidth, clearly labelled as cached.
+
+Run on local CPU:  JAX_PLATFORMS=cpu python scripts/roofline.py --md ROOFLINE.md
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+#: v5e HBM bandwidth (GB/s, datasheet) — the roof for cached on-chip rates.
+V5E_HBM_GBPS = 819.0
+
+YEAR_MS = 365.2425 * 86_400_000.0
+
+
+def log(msg: str) -> None:
+    print(f"[roofline] {msg}", file=sys.stderr, flush=True)
+
+
+def cached_tpu_points(bandwidth_gbps: float) -> list[dict]:
+    """Pallas roofline points reconstructed from the perf log's end-to-end
+    headline rows (mode + sim_years_per_s) — served when this harness cannot
+    reach a TPU, so the committed report never loses the on-chip story."""
+    from bench import cached_tpu_numbers
+
+    from tpusim.config import (
+        SimConfig, default_network, reference_selfish_network,
+    )
+    from tpusim.pallas_engine import PallasEngine
+    from tpusim.profiling import bytes_per_event
+
+    cached = cached_tpu_numbers()
+    if cached is None:
+        return []
+    nets = {
+        "fast": default_network(propagation_ms=1000),
+        "exact": reference_selfish_network(),
+    }
+    points = []
+    for mode, row in (("fast", cached.get("fast")), ("exact", cached.get("exact"))):
+        if not row:
+            continue
+        cfg = SimConfig(network=nets[mode], runs=8192, batch_size=8192)
+        try:
+            eng = PallasEngine(cfg, interpret=True)  # traffic model only
+        except ValueError:
+            continue
+        model = bytes_per_event(eng)
+        events_per_year = 2.0 * cfg.duration_ms / (
+            cfg.network.block_interval_s * 1000.0
+        )
+        events_per_s = row["sim_years_per_s"] * events_per_year
+        roof = bandwidth_gbps * 1e9 / model["pallas"]
+        points.append({
+            "engine": "PallasEngine",
+            "measurement": "cached (artifacts/perf_tpu.jsonl, "
+                           + str(row.get("date", "?")) + ")",
+            "chip": row.get("chip"),
+            "mode": mode,
+            "runs": None,
+            "chunk_steps": eng.chunk_steps,
+            "superstep": eng.superstep,
+            "traffic_model": "pallas",
+            "state_bytes_per_run": model["state_bytes_per_run"],
+            "bytes_per_event": round(model["pallas"], 2),
+            "sim_years_per_s": row["sim_years_per_s"],
+            "events_per_s": round(events_per_s, 1),
+            "bandwidth_gbps": bandwidth_gbps,
+            "roof_events_per_s": round(roof, 1),
+            "fraction_of_roof": round(events_per_s / roof, 4),
+        })
+    return points
+
+
+def measure_points(args, platform: str, bandwidth_gbps: float) -> list[dict]:
+    import jax
+
+    from tpusim.config import (
+        SimConfig, default_network, reference_selfish_network,
+    )
+    from tpusim.engine import Engine
+    from tpusim.profiling import roofline_point
+    from tpusim.runner import make_run_keys
+
+    nets = {
+        "fast": default_network(propagation_ms=1000),
+        "exact": reference_selfish_network(),
+    }
+    points = []
+    for mode in args.modes:
+        net = nets[mode]
+        for batch in args.batch_list:
+            keys = make_run_keys(7, 0, batch)
+            for k in args.k_list:
+                cfg = SimConfig(
+                    network=net, duration_ms=365 * 86_400_000, runs=batch,
+                    batch_size=batch, seed=7, chunk_steps=args.chunk_steps,
+                    superstep=k,
+                )
+                engines = [Engine(cfg)]
+                if platform == "tpu":
+                    from tpusim.pallas_engine import PallasEngine
+
+                    try:
+                        engines.append(PallasEngine(cfg))
+                    except ValueError as e:
+                        log(f"no pallas point for {mode}/{batch}/K={k}: {e}")
+                for eng in engines:
+                    t0 = time.monotonic()
+                    p = roofline_point(
+                        eng, keys, bandwidth_gbps=bandwidth_gbps,
+                        n_chunks=args.n_chunks, repeats=args.repeats,
+                    )
+                    p.update(platform=platform, batch=batch)
+                    points.append(p)
+                    log(
+                        f"{mode}/{type(eng).__name__} batch={batch} K={k}: "
+                        f"{p['events_per_s']:.0f} ev/s "
+                        f"({100 * p['fraction_of_roof']:.1f}% of roof, "
+                        f"{time.monotonic() - t0:.1f}s)"
+                    )
+    return points
+
+
+def render_md(doc: dict) -> str:
+    plat = doc["platform"]
+    bw = doc["bandwidth_gbps"]
+    lines = [
+        "# Roofline: measured event rate vs the memory-bandwidth bound",
+        "",
+        f"Generated by `scripts/roofline.py` on platform `{plat}` "
+        f"({doc['chip']}), {doc['date']}.",
+        "",
+        "## Traffic model",
+        "",
+        "An *event* is one scan step of one run (a potential block find plus",
+        "the notify sweep). The bandwidth bound counts unavoidable memory",
+        "traffic only:",
+        "",
+        "- **scan engine** — the `lax.scan` carry round-trips the whole",
+        "  per-run state tree through memory every event:",
+        "  `bytes/event = 2 x state + 8` (8 = the streamed RNG word pair).",
+        "- **Pallas kernel** — state is VMEM-resident for a whole chunk and",
+        "  crosses HBM once per chunk each way:",
+        "  `bytes/event = 2 x state / chunk_steps + 8`.",
+        "",
+        f"Measured copy bandwidth (STREAM-style jitted saxpy, read+write): "
+        f"**{bw:.1f} GB/s** on this host"
+        + (f"; cached TPU rows use the v5e datasheet {V5E_HBM_GBPS:.0f} GB/s."
+           if doc.get("cached_tpu_points") else "."),
+        "",
+        "The *superstep* width K (events unrolled per scan step / kernel loop",
+        "iteration) does not change the model — it attacks per-step control",
+        "overhead, i.e. the distance from the roof, not the roof itself.",
+        "",
+        "## Measured points",
+        "",
+        "| engine | mode | batch | K | events/s | bytes/event | roof events/s | % of roof |",
+        "|---|---|---:|---:|---:|---:|---:|---:|",
+    ]
+    for p in doc["points"]:
+        lines.append(
+            f"| {p['engine']} | {p['mode']} | {p.get('batch') or ''} "
+            f"| {p['superstep']} | {p['events_per_s']:,.0f} "
+            f"| {p['bytes_per_event']:.0f} | {p['roof_events_per_s']:,.0f} "
+            f"| {100 * p['fraction_of_roof']:.2f}% |"
+        )
+    for p in doc.get("cached_tpu_points", []):
+        lines.append(
+            f"| {p['engine']} ({p['measurement']}) | {p['mode']} |  "
+            f"| {p['superstep']} | {p['events_per_s']:,.0f} "
+            f"| {p['bytes_per_event']:.0f} | {p['roof_events_per_s']:,.0f} "
+            f"| {100 * p['fraction_of_roof']:.2f}% |"
+        )
+    scan_points = [p for p in doc["points"] if p["traffic_model"] == "scan"]
+    best = max(scan_points, key=lambda p: p["fraction_of_roof"], default=None)
+    if best is not None:
+        lines += [
+            "",
+            "## Reading",
+            "",
+            f"The best measured scan point reaches "
+            f"**{100 * best['fraction_of_roof']:.1f}%** of the bandwidth-bound"
+            f" event rate ({best['roof_events_per_s']:,.0f} events/s at "
+            f"{best['bytes_per_event']:.0f} bytes/event); the remaining gap "
+            "is per-event control and compute overhead, not memory traffic — "
+            "which is why supersteps and pipelined dispatch (not layout "
+            "changes) are the levers this report tracks.",
+        ]
+    pallas_rows = [
+        p for p in doc["points"] + doc.get("cached_tpu_points", [])
+        if p.get("traffic_model") == "pallas"
+    ]
+    if pallas_rows:
+        frac = max(p["fraction_of_roof"] for p in pallas_rows)
+        lines += [
+            "",
+            f"The Pallas kernel sits at **{100 * frac:.2f}%** of its HBM "
+            "roof: VMEM residency already removed per-event state traffic "
+            "(~8-12 streamed bytes/event remain), so the kernel is "
+            "compute-bound, not bandwidth-bound — closing the north-star gap "
+            "is about per-event VPU work (miner-axis contractions, notify "
+            "selects), not memory layout.",
+        ]
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--modes", default="fast,exact",
+                    type=lambda s: s.split(","))
+    ap.add_argument("--k-list", default="1,2,4,8",
+                    type=lambda s: [int(x) for x in s.split(",")])
+    ap.add_argument("--batch-list", default="64,256",
+                    type=lambda s: [int(x) for x in s.split(",")])
+    ap.add_argument("--chunk-steps", type=int, default=256,
+                    help="pinned chunk_steps for comparable K points")
+    ap.add_argument("--n-chunks", type=int, default=12)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--out", type=Path, default=None,
+                    help="JSON output (default artifacts/roofline_<platform>.json)")
+    ap.add_argument("--md", type=Path, default=None,
+                    help="also render the markdown report here (e.g. ROOFLINE.md)")
+    args = ap.parse_args()
+
+    import jax
+
+    from tpusim.profiling import measure_copy_bandwidth_gbps
+
+    platform = jax.devices()[0].platform
+    log(f"platform: {platform}")
+    bw = measure_copy_bandwidth_gbps()
+    log(f"measured copy bandwidth: {bw:.2f} GB/s")
+
+    points = measure_points(args, platform, bw)
+    doc = {
+        "date": time.strftime("%Y-%m-%d"),
+        "platform": platform,
+        "chip": str(jax.devices()[0]),
+        "bandwidth_gbps": round(bw, 2),
+        "chunk_steps": args.chunk_steps,
+        "points": points,
+    }
+    if platform != "tpu":
+        doc["cached_tpu_points"] = cached_tpu_points(V5E_HBM_GBPS)
+
+    out = args.out or REPO / "artifacts" / f"roofline_{platform}.json"
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(doc, indent=2) + "\n")
+    log(f"wrote {out}")
+    if args.md is not None:
+        args.md.write_text(render_md(doc))
+        log(f"wrote {args.md}")
+    print(json.dumps({
+        "points": len(points),
+        "bandwidth_gbps": doc["bandwidth_gbps"],
+        "out": str(out),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

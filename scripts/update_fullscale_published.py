@@ -95,11 +95,20 @@ def main() -> int:
                 or prior.get("_chain_blocks")
                 or 365.2425 * 86400 / 600.0
             )
-            sigma_diff = (2 * s * (1 - s) / blocks_per_run) ** 0.5 / tpu["runs"] ** 0.5
             diff = abs(s - prior["_share_raw"])
             tpu["selfish_share_native"] = prior["selfish_share"]
             tpu["share_abs_diff_vs_native"] = round(diff, 7)
-            tpu["share_diff_in_sigma_units"] = round(diff / sigma_diff, 2)
+            # A degenerate row (share exactly 0 or 1, or a zero chain
+            # length) has no defined Monte-Carlo envelope; publish a null
+            # sigma annotation instead of aborting the whole pass on a
+            # division by zero.
+            if s * (1 - s) > 0 and blocks_per_run > 0:
+                sigma_diff = (
+                    (2 * s * (1 - s) / blocks_per_run) ** 0.5 / tpu["runs"] ** 0.5
+                )
+                tpu["share_diff_in_sigma_units"] = round(diff / sigma_diff, 2)
+            else:
+                tpu["share_diff_in_sigma_units"] = None
             tpu["native_elapsed_s"] = prior["elapsed_s"]
         pts[name] = tpu
     for p in pts.values():

@@ -22,4 +22,7 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+
+from tpusim.compat import set_cpu_device_count  # noqa: E402
+
+set_cpu_device_count(8)

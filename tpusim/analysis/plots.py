@@ -164,13 +164,20 @@ def plot_selfish_crossing(
         ax.plot([x for x, _ in pts], [y for _, y in pts],
                 marker, color=color, markersize=6, linestyle=":",
                 label=f"measured ({backend})")
-    # Bracket the measured crossing from the point set itself.
+    # Bracket the measured crossing from the point set itself. Noisy low-run
+    # points can make the measured shares non-monotonic, leaving lo >= hi —
+    # an unbracketed crossing, not a reversed band (mirrors
+    # crossing_bracket() in scripts/update_fullscale_published.py).
     below = [x for b in by_backend.values() for x, y in b if y <= x]
     above = [x for b in by_backend.values() for x, y in b if y > x]
     if below and above:
         lo, hi = max(below), min(above)
-        ax.axvspan(lo, hi, alpha=0.15, color="tab:red",
-                   label=f"measured crossing ({lo * 100:.0f}%, {hi * 100:.0f}%)")
+        if lo < hi:
+            ax.axvspan(lo, hi, alpha=0.15, color="tab:red",
+                       label=f"measured crossing ({lo * 100:.0f}%, {hi * 100:.0f}%)")
+        else:
+            ax.plot([], [], " ",
+                    label="measured crossing unbracketed (non-monotonic points)")
     ax.set_xlabel("selfish hashrate fraction")
     ax.set_ylabel("block share (relative revenue)")
     ax.set_title("Selfish-mining profitability: simulated vs ideal model")
@@ -269,7 +276,13 @@ def load_selfish_grid_points(paths: Sequence[str | Path]) -> list[dict]:
                 name = r.get("point")
                 if name is not None and not re.fullmatch(r"selfish-\d+pct", name):
                     continue
-                backend_r = r.get("backend", backend)
+                # Backend resolution order: the row's own backend key, then
+                # its mode (the cpp backend stamps mode=='cpp'), and only
+                # then the filename heuristic — so a legacy cpp-produced file
+                # not named 'native'/'cpp' is still attributed correctly.
+                backend_r = r.get("backend") or (
+                    "cpp" if r.get("mode") == "cpp" else backend
+                )
                 key = (backend_r, m0["hashrate_pct"])
                 if key in best and best[key]["runs"] >= r["runs"]:
                     continue

@@ -1,0 +1,52 @@
+"""Version shims for the jax APIs this package uses that moved between
+releases. The container image pins jax 0.4.x where ``shard_map`` still lives
+in ``jax.experimental`` (with ``check_rep`` instead of ``check_vma``) and
+``enable_x64`` in ``jax.experimental``; newer jax exports both from the top
+level. Everything in-repo imports them from here so one module owns the
+difference.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map", "enable_x64", "set_cpu_device_count"]
+
+
+def set_cpu_device_count(n: int) -> None:
+    """Request ``n`` virtual CPU devices. Older jax (< 0.5) has no
+    ``jax_num_cpu_devices`` option — there callers must have set
+    ``XLA_FLAGS=--xla_force_host_platform_device_count`` before backend
+    init, and this is a no-op."""
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        pass
+
+try:
+    from jax import shard_map as _new_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        return _new_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+        )
+
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        return _old_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma
+        )
+
+
+if hasattr(jax, "enable_x64"):
+
+    def enable_x64(new_val: bool = True):
+        return jax.enable_x64(new_val)
+
+else:
+    from jax.experimental import enable_x64 as _old_enable_x64
+
+    def enable_x64(new_val: bool = True):
+        return _old_enable_x64(new_val)

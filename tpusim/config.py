@@ -147,6 +147,15 @@ class SimConfig:
     group_slots: int | None = None
     mode: str = "auto"
     chunk_steps: int | None = None
+    #: Events unrolled per device loop iteration (the *superstep* width K).
+    #: The per-event RNG word mapping is unchanged for every K — event e of a
+    #: chunk always consumes word pair e of that chunk's threefry block — so
+    #: K is a pure compile-time performance knob: results are bit-identical
+    #: across K and it is NOT part of the sampling identity or checkpoint
+    #: fingerprint. None = auto (a measured default; reduced to a divisor of
+    #: the resolved chunk_steps / step_block). An explicit K must divide the
+    #: resolved chunk_steps (and the Pallas step_block) or the engine raises.
+    superstep: int | None = None
     #: Sampling generator. ``"threefry"`` (default): counter-based JAX draws,
     #: order-independent, one (winner, interval) word pair burned per scan
     #: step. ``"xoroshiro"``: the reference's xoroshiro128++ as two sequential
@@ -170,6 +179,8 @@ class SimConfig:
             raise ValueError("group_slots must be >= 2 (or None for auto)")
         if self.chunk_steps is not None and self.chunk_steps < 1:
             raise ValueError("chunk_steps must be >= 1 (or None for auto)")
+        if self.superstep is not None and self.superstep < 1:
+            raise ValueError("superstep must be >= 1 (or None for auto)")
         # 32-bit time-arithmetic envelope (see tpusim.state docstring): one
         # interval draw must stay far below INTERVAL_CAP = 2^27 ms, and
         # propagation delays below one chunk re-base span.
@@ -236,6 +247,7 @@ def _config_to_dict(cfg: SimConfig) -> dict[str, Any]:
         "group_slots": cfg.group_slots,
         "mode": cfg.mode,
         "chunk_steps": cfg.chunk_steps,
+        "superstep": cfg.superstep,
         "rng": cfg.rng,
     }
 
@@ -259,6 +271,8 @@ def _config_from_dict(d: dict[str, Any]) -> SimConfig:
         kwargs["group_slots"] = int(d["group_slots"])
     if d.get("chunk_steps") is not None:
         kwargs["chunk_steps"] = int(d["chunk_steps"])
+    if d.get("superstep") is not None:
+        kwargs["superstep"] = int(d["superstep"])
     if "mode" in d:
         kwargs["mode"] = str(d["mode"])
     if "rng" in d:

@@ -41,7 +41,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from .compat import shard_map
 
 from .config import SimConfig
 from .sampling import interval_from_bits, winner_from_bits
@@ -58,10 +58,39 @@ from .state import (
     rebase,
 )
 
-__all__ = ["Engine", "default_n_steps"]
+__all__ = ["Engine", "default_n_steps", "resolve_superstep", "DEFAULT_SUPERSTEP"]
 
 #: Per-batch int32 block-count sums stay exact below this many blocks.
 _I32_SUM_GUARD = 2**31 - 1
+
+#: Auto superstep width K: events unrolled per scan step / kernel loop
+#: iteration. Measured on this container's 2-core CPU (scripts/roofline.py
+#: K-ablation, medians of repeated 45d batches): fast mode is ~15-25% faster
+#: at K=2 than K=1 at the bench batch sizes while K>=4 regresses (the
+#: unrolled body spills); exact mode regresses at every K>1 (its step is
+#: already compute-heavy), so its auto default stays 1. Powers of two <= 64
+#: always divide the 64-aligned auto chunk_steps and the Pallas step_block.
+DEFAULT_SUPERSTEP = 2
+DEFAULT_SUPERSTEP_EXACT = 1
+
+
+def resolve_superstep(requested: int | None, divisor: int, *, exact: bool = False) -> int:
+    """The unroll width actually compiled: an explicit request must divide
+    ``divisor`` (chunk_steps for the scan engine, step_block for the Pallas
+    kernel) exactly — a silent trim would compile a different program than
+    the one asked for; the auto default halves itself until it divides (K=1
+    always does)."""
+    if requested is not None:
+        if divisor % requested:
+            raise ValueError(
+                f"superstep ({requested}) must divide {divisor} (the resolved "
+                f"chunk_steps / step_block)"
+            )
+        return requested
+    k = DEFAULT_SUPERSTEP_EXACT if exact else DEFAULT_SUPERSTEP
+    while divisor % k:
+        k //= 2
+    return max(k, 1)
 
 
 def _host_reduce_sums(out: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
@@ -218,10 +247,21 @@ class Engine:
             + 4
         )
 
+        # Superstep width: K events unrolled per lax.scan step. The scan
+        # carry round-trip (the whole state tree) is paid once per K events
+        # instead of per event, and the draws are untouched — event e of a
+        # chunk still consumes word pair e of the chunk's threefry block, so
+        # results are bit-identical across K (pinned by
+        # tests/test_superstep.py).
+        self.superstep = resolve_superstep(
+            config.superstep, self.chunk_steps, exact=self.exact
+        )
+
         m, k, exact, steps = (
             self.n_miners, config.resolved_group_slots, self.exact, self.chunk_steps
         )
         any_selfish = self.any_selfish
+        K = self.superstep
 
         xoro = config.rng == "xoroshiro"
 
@@ -248,11 +288,12 @@ class Engine:
 
                 def body(carry, _):
                     st, xi, xw = carry
-                    st, xi, xw = _step_xoro(st, xi, xw, params, cap, any_selfish)
+                    for _j in range(K):
+                        st, xi, xw = _step_xoro(st, xi, xw, params, cap, any_selfish)
                     return (st, xi, xw), None
 
                 (state, xi, xw), _ = jax.lax.scan(
-                    body, (state, xi, xw), None, length=steps
+                    body, (state, xi, xw), None, length=steps // K
                 )
                 state, elapsed = rebase(state)
                 return state, (xi, xw), elapsed
@@ -270,10 +311,16 @@ class Engine:
                 chunk_idx: jax.Array, params: SimParams,
             ):
                 key = jax.random.fold_in(run_key, 1 + chunk_idx)
+                # The (steps, 2) word block reshaped to (steps/K, K, 2): scan
+                # step s row j is word pair s*K + j — the same per-event
+                # mapping as K=1, just consumed K events at a time.
                 bits = jax.random.bits(key, (steps, 2), jnp.uint32)
+                bits = bits.reshape(steps // K, K, 2)
 
                 def body(carry: SimState, xs: jax.Array):
-                    return _step(carry, xs, params, cap, any_selfish), None
+                    for j in range(K):
+                        carry = _step(carry, xs[j], params, cap, any_selfish)
+                    return carry, None
 
                 state, _ = jax.lax.scan(body, state, bits)
                 state, elapsed = rebase(state)
@@ -307,6 +354,12 @@ class Engine:
             self._chunk = jax.jit(vchunk)
             self._finalize = jax.jit(finalize_fn)
             self._run_device = jax.jit(self._device_loop)
+            # Pipelined per-chunk program: state, aux and the ledger pair are
+            # donated — each chunk writes into its predecessor's buffers —
+            # and the only host-fetched value per chunk is the int32
+            # unfinished flag, so the host can run several chunks ahead of
+            # the device (see _run_batch_pipelined).
+            self._pipe_chunk = jax.jit(self._ledger_chunk, donate_argnums=(0, 1, 2, 3))
         else:
             # check_vma off: scan carries start as unvarying constants but
             # become varying over the sharded runs axis after the first step.
@@ -405,6 +458,25 @@ class Engine:
                     )
                 )
 
+                def sharded_ledger_chunk(state, aux, hi, lo, keys, chunk_idx, params):
+                    out = self._ledger_chunk(state, aux, hi, lo, keys, chunk_idx, params)
+                    # The done decision must be global: every shard returns
+                    # the mesh-wide max of its local unfinished flag.
+                    return out[:-1] + (jax.lax.pmax(out[-1], "runs"),)
+
+                self._pipe_chunk = jax.jit(
+                    shard_map(
+                        sharded_ledger_chunk, mesh=mesh,
+                        in_specs=(
+                            P("runs"), P("runs"), P("runs"), P("runs"),
+                            P("runs"), P(), rep_params,
+                        ),
+                        out_specs=(P("runs"), P("runs"), P("runs"), P("runs"), P()),
+                        check_vma=False,
+                    ),
+                    donate_argnums=(0, 1, 2, 3),
+                )
+
     def make_keys(self, start: int, count: int) -> jax.Array:
         """The per-run sampling-identity array for global run indices
         [start, start+count) — threefry keys by default, packed xoroshiro
@@ -423,6 +495,14 @@ class Engine:
     # event can overshoot the cap), so one borrow per chunk suffices and the
     # final (possibly negative) t_end fits a single int32 limb.
     _LEDGER_BASE = 1 << 30
+
+    def _ledger_init(self, n: int) -> tuple[jax.Array, jax.Array]:
+        """Split ``duration_ms`` into the per-run (hi, lo) int32 ledger pair."""
+        dur = int(self.config.duration_ms)
+        shift = self._LEDGER_BASE.bit_length() - 1
+        hi = jnp.full((n,), dur >> shift, jnp.int32)
+        lo = jnp.full((n,), dur & (self._LEDGER_BASE - 1), jnp.int32)
+        return hi, lo
 
     def _device_loop(self, keys: jax.Array, hi0: jax.Array, lo0: jax.Array,
                      params: SimParams) -> dict[str, jax.Array]:
@@ -465,18 +545,65 @@ class Engine:
         sums["unfinished"] = jnp.any((hi > 0) | (lo > 0))
         return sums
 
-    def run_batch(self, keys: jax.Array, *, host_loop: bool = False) -> dict[str, np.ndarray]:
-        """Simulate one batch of runs to completion; returns stat sums.
+    def _ledger_chunk(self, state, aux, hi, lo, keys, chunk_idx, params):
+        """One chunk of :meth:`_device_loop`'s body as a standalone jitted
+        step: cap from the device-resident ledger, run the chunk, subtract
+        elapsed with one borrow, and reduce the all-runs-done decision to a
+        single int32 ``unfinished`` flag — the only value the pipelined host
+        loop ever fetches. A finished batch's extra chunks are exact no-ops
+        (cap=0 freezes every run and rebase of an all-zero clock elapses 0)."""
+        base = jnp.int32(self._LEDGER_BASE)
+        tc = jnp.int32(int(TIME_CAP))
+        cap = jnp.maximum(jnp.where(hi > 0, tc, jnp.minimum(lo, tc)), 0)
+        state, aux, elapsed = self._chunk_impl(state, aux, cap, keys, chunk_idx, params)
+        lo = lo - elapsed
+        borrow = (lo < 0) & (hi > 0)
+        hi = jnp.where(borrow, hi - 1, hi)
+        lo = jnp.where(borrow, lo + base, lo)
+        unfinished = jnp.any((hi > 0) | (lo > 0)).astype(jnp.int32)
+        return state, aux, hi, lo, unfinished
 
-        Single-device and single-controller meshes: one jitted
-        device-resident program per batch (:meth:`_device_loop`, shard-mapped
-        over the mesh when there is one). Multi-controller meshes (or
-        ``host_loop=True``, kept for device/host-loop equivalence tests):
-        jitted chunk -> re-base -> subtract elapsed from the int64 remaining
-        ledger on the host -> repeat until every run finishes. All paths draw
-        identically and produce bit-identical sums.
-        """
+    _PIPELINE_DEPTH = 2
+
+    def _run_batch_pipelined(self, keys: jax.Array) -> dict[str, np.ndarray]:
+        """Per-chunk dispatch loop that never blocks on the chunk it just
+        dispatched: the ledger lives on device as the (hi, lo) int32 pair,
+        state/aux/ledger buffers are donated chunk-to-chunk, and the host
+        checks chunk c's ``unfinished`` flag only after dispatching chunks
+        c+1..c+depth — so the host-side Python/dispatch work (and everything
+        the caller does between batches) overlaps device compute instead of
+        serializing with it. Draw-for-draw identical to the device loop and
+        the host loop: same chunk program, same cap rule, same ledger
+        arithmetic."""
+        from collections import deque
+
         n = keys.shape[0]
+        hi, lo = self._ledger_init(n)
+        state, aux = self._init(keys, self.params)
+        flags: deque = deque()
+        finished = False
+        for chunk_idx in range(self.max_chunks):
+            state, aux, hi, lo, unfin = self._pipe_chunk(
+                state, aux, hi, lo, keys, jnp.asarray(chunk_idx, jnp.uint32), self.params
+            )
+            flags.append(unfin)
+            if len(flags) > self._PIPELINE_DEPTH and int(flags.popleft()) == 0:
+                finished = True
+                break
+        while not finished and flags:
+            finished = int(flags.popleft()) == 0
+        if not finished:
+            raise RuntimeError(
+                f"batch did not finish within {self.max_chunks} chunks of "
+                f"{self.chunk_steps} steps — event count beyond the Poisson bound"
+            )
+        t_end = hi * jnp.int32(self._LEDGER_BASE) + lo
+        sums = self._finalize(state, t_end)
+        out = _host_reduce_sums({k: np.asarray(v) for k, v in sums.items()})
+        out["runs"] = np.int64(n)
+        return out
+
+    def _batch_guard(self, n: int) -> None:
         duration = self.config.duration_ms
         blocks_bound = n * (duration / (self.config.network.block_interval_s * 1000.0)) * 1.1
         if blocks_bound > _I32_SUM_GUARD:
@@ -484,14 +611,54 @@ class Engine:
                 f"batch of {n} runs x {duration} ms overflows int32 block-count "
                 f"sums; lower batch_size below {int(_I32_SUM_GUARD / (blocks_bound / n))}"
             )
-        device_loop_ok = self.mesh is None or (
+
+    def _device_loop_ok(self, n: int) -> bool:
+        return self.mesh is None or (
             jax.process_count() == 1 and n % self.mesh.devices.size == 0
         )
-        if device_loop_ok and not host_loop:
-            dur = int(duration)
-            hi0 = jnp.full((n,), dur >> 30, jnp.int32)
-            lo0 = jnp.full((n,), dur & (self._LEDGER_BASE - 1), jnp.int32)
-            sums = self._run_device(keys, hi0, lo0, self.params)
+
+    def run_batch(
+        self, keys: jax.Array, *, host_loop: bool = False, pipelined: bool = False
+    ) -> dict[str, np.ndarray]:
+        """Simulate one batch of runs to completion; returns stat sums.
+
+        Single-device and single-controller meshes: one jitted
+        device-resident program per batch (:meth:`_device_loop`, shard-mapped
+        over the mesh when there is one), or — with ``pipelined=True`` — the
+        per-chunk pipelined dispatch loop of :meth:`_run_batch_pipelined`.
+        Multi-controller meshes (or ``host_loop=True``, kept for
+        device/host-loop equivalence tests): jitted chunk -> re-base ->
+        subtract elapsed from the int64 remaining ledger on the host ->
+        repeat until every run finishes. All paths draw identically and
+        produce bit-identical sums.
+        """
+        n = keys.shape[0]
+        self._batch_guard(n)
+        if self._device_loop_ok(n) and not host_loop:
+            if pipelined:
+                return self._run_batch_pipelined(keys)
+            return self.run_batch_async(keys)()
+        return self._run_batch_hostloop(keys)
+
+    def run_batch_async(self, keys: jax.Array):
+        """Dispatch one whole batch (the device-resident loop) and return a
+        zero-argument finalize callable; the device computes in the
+        background until the callable is invoked, which blocks on the
+        transfer, validates the chunk-limit flag and returns the stat sums.
+        This is the batch-level pipelining hook: dispatch batch c+1 before
+        finalizing batch c and the host-side reduction/bookkeeping of c
+        overlaps c+1's device time. Falls back to a synchronous host-loop
+        run (wrapped in a trivial callable) when the device loop is not
+        eligible."""
+        n = keys.shape[0]
+        self._batch_guard(n)
+        if not self._device_loop_ok(n):
+            out = self._run_batch_hostloop(keys)
+            return lambda: out
+        hi0, lo0 = self._ledger_init(n)
+        sums = self._run_device(keys, hi0, lo0, self.params)
+
+        def finalize() -> dict[str, np.ndarray]:
             out = _host_reduce_sums({k: np.asarray(v) for k, v in sums.items()})
             n_chunks = int(out.pop("n_chunks"))
             if out.pop("unfinished"):
@@ -502,7 +669,8 @@ class Engine:
                 )
             out["runs"] = np.int64(n)
             return out
-        return self._run_batch_hostloop(keys)
+
+        return finalize
 
     def _run_batch_hostloop(self, keys: jax.Array) -> dict[str, np.ndarray]:
         """Per-chunk host loop (see :meth:`run_batch`).

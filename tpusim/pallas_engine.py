@@ -89,12 +89,15 @@ def _leaf_shapes(m: int, k: int, exact: bool) -> list[tuple[int, ...]]:
 
 
 def _make_kernel(
-    *, exact: bool, any_selfish: bool, sb: int, mean_interval_ms: float, n_state: int
+    *, exact: bool, any_selfish: bool, sb: int, mean_interval_ms: float,
+    n_state: int, superstep: int = 1
 ):
     """Build the step-block kernel for one mode. Ref order: bits, cap, lo,
     hi, prop, selfish, then ``n_state`` input state refs (HBM-aliased to the
     outputs), then ``n_state`` output state refs (the live, VMEM-resident
-    copies)."""
+    copies). ``superstep`` events are unrolled per fori_loop iteration —
+    event e still reads bits row e, so draws (and results) are identical for
+    every width."""
 
     def kernel(bits_ref, cap_ref, lo_ref, hi_ref, prop_ref, selfish_ref, *state_refs):
         ins, outs = state_refs[:n_state], state_refs[n_state:]
@@ -417,8 +420,13 @@ def _make_kernel(
                 return jnp.where(kidx == 0, val[0][:, None, :], val[1][:, None, :])
             return val
 
+        def superblock(s, carry):
+            for j in range(superstep):
+                carry = step(s * superstep + j, carry)
+            return carry
+
         carry = tuple(load(ref, name) for ref, name in zip(outs, names))
-        carry = jax.lax.fori_loop(0, sb, step, carry)
+        carry = jax.lax.fori_loop(0, sb // superstep, superblock, carry)
         for ref, val, name in zip(outs, carry, names):
             ref[...] = stored(val, name)
 
@@ -513,6 +521,15 @@ class PallasEngine(Engine):
                 f"chunk_steps ({self.chunk_steps}) must be a multiple of "
                 f"step_block ({step_block}) for the pallas engine"
             )
+        # The kernel unrolls whole supersteps inside a step block; re-resolve
+        # K against step_block (Engine resolved it against chunk_steps, a
+        # multiple of step_block, so an explicit valid K stays unchanged and
+        # the auto default can only shrink).
+        from .engine import resolve_superstep
+
+        self.superstep = resolve_superstep(
+            config.superstep, step_block, exact=self.exact
+        )
         self.tile_runs = tile_runs
         self.interpret = interpret
 
@@ -536,8 +553,9 @@ class PallasEngine(Engine):
         if mesh is None:
             self._chunk = jax.jit(self._pallas_chunk)
         else:
-            from jax import shard_map
             from jax.sharding import PartitionSpec as P
+
+            from .compat import shard_map
 
             rep_params = jax.tree_util.tree_map(lambda _: P(), self.params)
             self._chunk = jax.jit(
@@ -563,7 +581,7 @@ class PallasEngine(Engine):
             )
         return self._scan_fallback
 
-    def run_batch(self, keys, *, host_loop: bool = False):
+    def run_batch(self, keys, *, host_loop: bool = False, pipelined: bool = False):
         """Tile-misaligned batches split: the aligned prefix runs on the
         kernel, the remainder on the draw-identical scan twin. With a mesh
         the alignment unit is ``tile_runs`` per device (every device's shard
@@ -572,17 +590,32 @@ class PallasEngine(Engine):
         unit = self.tile_runs * (1 if self.mesh is None else self.mesh.devices.size)
         rem = n % unit
         if rem == 0:
-            return super().run_batch(keys, host_loop=host_loop)
+            return super().run_batch(keys, host_loop=host_loop, pipelined=pipelined)
         logger.info(
             "batch of %d is not a multiple of %d (tile_runs x devices); "
             "%d run(s) take the scan engine",
             n, unit, rem,
         )
         if n < unit:
-            return self.scan_twin().run_batch(keys, host_loop=host_loop)
-        head = super().run_batch(keys[: n - rem], host_loop=host_loop)
-        tail = self.scan_twin().run_batch(keys[n - rem:], host_loop=host_loop)
+            return self.scan_twin().run_batch(
+                keys, host_loop=host_loop, pipelined=pipelined
+            )
+        head = super().run_batch(keys[: n - rem], host_loop=host_loop, pipelined=pipelined)
+        tail = self.scan_twin().run_batch(
+            keys[n - rem:], host_loop=host_loop, pipelined=pipelined
+        )
         return {k: head[k] + tail[k] for k in head}
+
+    def run_batch_async(self, keys):
+        """Async dispatch only for whole-tile batches; a misaligned batch
+        needs the head/tail split of :meth:`run_batch`, which is inherently
+        synchronous — wrap its (already computed) result instead."""
+        n = keys.shape[0]
+        unit = self.tile_runs * (1 if self.mesh is None else self.mesh.devices.size)
+        if n % unit == 0:
+            return super().run_batch_async(keys)
+        out = self.run_batch(keys)
+        return lambda: out
 
     def _state_to_kernel(self, state: SimState):
         """SimState (runs-first) -> ordered runs-last leaf tuple. The exact
@@ -662,7 +695,7 @@ class PallasEngine(Engine):
         kernel = _make_kernel(
             exact=self.exact, any_selfish=self.any_selfish, sb=sb,
             mean_interval_ms=float(self.params.mean_interval_ms),
-            n_state=len(shapes),
+            n_state=len(shapes), superstep=self.superstep,
         )
         grid = (n // tile, steps // sb)
         out = pl.pallas_call(

@@ -6,10 +6,12 @@ framework: compile-vs-run phase separation, steady-state throughput counters
 (sim-years/sec/chip — the headline unit of BASELINE.md), and device-level
 traces. This module provides both layers:
 
-  * ``Profiler`` — host-side phase/batch accounting. The runner enters
-    ``profiler.batch(n)`` around every device batch; the report separates the
-    first batch (which pays XLA compilation) from steady-state batches and
-    derives runs/sec, sim-years/sec and events/sec.
+  * ``Profiler`` — host-side phase/batch accounting. The pipelined runner
+    times each device batch completion-to-completion and feeds the wall time
+    to ``profiler.record(n, elapsed_s)`` (a context manager around finalize
+    would double-count the dispatch/compute overlap); the report separates
+    the first batch (which pays XLA compilation) from steady-state batches
+    and derives runs/sec, sim-years/sec and events/sec.
   * ``Profiler.trace`` — wraps ``jax.profiler.trace`` so a sweep can emit an
     XLA device trace (viewable in TensorBoard/XProf) without any call-site
     knowing profiler internals. No-op unless ``trace_dir`` is set.
@@ -39,13 +41,12 @@ class Profiler:
     trace_dir: str | None = None
     records: list[BatchRecord] = dataclasses.field(default_factory=list)
 
-    @contextlib.contextmanager
-    def batch(self, runs: int) -> Iterator[None]:
-        # Records only successful batches: a failed attempt that the runner
-        # retries must not double-count its runs in the throughput report.
-        t0 = time.perf_counter()
-        yield
-        self.records.append(BatchRecord(runs, time.perf_counter() - t0))
+    def record(self, runs: int, elapsed_s: float) -> None:
+        """Record an externally-timed batch — the pipelined runner times each
+        batch as completion-to-completion wall time (dispatch of batch c+1
+        overlaps finalize of batch c, so a nested context manager would
+        double-count the overlap)."""
+        self.records.append(BatchRecord(runs, elapsed_s))
 
     @contextlib.contextmanager
     def trace(self) -> Iterator[None]:
@@ -145,8 +146,101 @@ def time_chained_chunks(
         "runs": int(n),
         "n_chunks": n_chunks,
         "chunk_steps": engine.chunk_steps,
+        "superstep": getattr(engine, "superstep", 1),
         "s_per_chunk": round(best / n_chunks, 6),
         "us_per_step": round(best / steps * 1e6, 3),
         "repeats_s": [round(t, 4) for t in times],
         "spread_pct": round(100.0 * (max(times) - best) / best, 1),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Roofline accounting (scripts/roofline.py drives these; ROOFLINE.md renders
+# the committed report).
+
+
+def state_bytes_per_run(engine) -> int:
+    """Bytes of simulation state per run: every int32 leaf of the engine's
+    mode/roster-resolved state tree (the Pallas kernel's leaf shapes are the
+    authority — they enumerate exactly the carried leaves in both modes)."""
+    import math as _math
+
+    from .pallas_engine import _leaf_shapes
+
+    m = engine.n_miners
+    k = engine.config.resolved_group_slots
+    return 4 * sum(_math.prod(s) for s in _leaf_shapes(m, k, engine.exact))
+
+
+def bytes_per_event(engine) -> dict[str, float]:
+    """Minimum memory traffic per simulated event for each execution style,
+    from the state size alone (the roofline's traffic model, not a
+    measurement):
+
+      * ``scan``  — the lax.scan carry makes one full read + write round
+        trip of the state tree per event, plus the 8-byte (winner, interval)
+        word pair: ``2 * state + 8``. Supersteps do NOT change this model —
+        K events per scan step still update every leaf K times; what K
+        amortizes is per-step *control* overhead, which a bandwidth model
+        deliberately excludes (that gap is visible as distance from the
+        roof).
+      * ``pallas`` — state stays resident in VMEM across a whole chunk and
+        crosses HBM once per chunk each way, so the per-event share is
+        ``2 * state / chunk_steps``, plus the same 8 streamed RNG bytes.
+    """
+    sb = state_bytes_per_run(engine)
+    return {
+        "state_bytes_per_run": sb,
+        "scan": 2.0 * sb + 8.0,
+        "pallas": 2.0 * sb / engine.chunk_steps + 8.0,
+    }
+
+
+def measure_copy_bandwidth_gbps(mib: int = 256, repeats: int = 3) -> float:
+    """Sustained device memory bandwidth from a jitted saxpy-like pass
+    (read + write of ``mib`` MiB), the denominator of the roofline: GB/s
+    counting both directions. Deliberately simple — a STREAM-style bound,
+    not a vendor spec sheet."""
+    import jax
+    import jax.numpy as jnp
+
+    n = mib * (1 << 20) // 4
+    x = jnp.arange(n, dtype=jnp.float32)
+    f = jax.jit(lambda v: v * 1.000001 + 1.0)
+    f(x).block_until_ready()
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        f(x).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return 2.0 * n * 4 / best / 1e9
+
+
+def roofline_point(
+    engine, keys, *, bandwidth_gbps: float, n_chunks: int = 12, repeats: int = 3
+) -> dict[str, Any]:
+    """One measured roofline point: chained-chunk events/s for this engine
+    against the bandwidth-bound event rate implied by its traffic model.
+    ``roof_events_per_s`` uses the model matching the engine type; the
+    reported fraction is how close the engine is to being memory-bound
+    (small fraction = control/compute overhead dominates)."""
+    from .pallas_engine import PallasEngine
+
+    timing = time_chained_chunks(engine, keys, n_chunks=n_chunks, repeats=repeats)
+    model = bytes_per_event(engine)
+    kind = "pallas" if isinstance(engine, PallasEngine) else "scan"
+    per_event = model[kind]
+    n = int(keys.shape[0])
+    events_per_s = n / (timing["us_per_step"] * 1e-6)
+    roof = bandwidth_gbps * 1e9 / per_event
+    return {
+        **timing,
+        "mode": engine.config.resolved_mode,
+        "traffic_model": kind,
+        "state_bytes_per_run": model["state_bytes_per_run"],
+        "bytes_per_event": round(per_event, 2),
+        "events_per_s": round(events_per_s, 1),
+        "bandwidth_gbps": round(bandwidth_gbps, 2),
+        "roof_events_per_s": round(roof, 1),
+        "fraction_of_roof": round(events_per_s / roof, 4),
     }

@@ -13,7 +13,6 @@ sweeps, and batch-level failure retry.
 
 from __future__ import annotations
 
-import contextlib
 import dataclasses
 import json
 import logging
@@ -179,6 +178,11 @@ def run_simulation_config(
     fp_dict = json.loads(config.to_json())
     fp_dict.pop("runs", None)
     fp_dict.pop("batch_size", None)
+    # The superstep width K changes only how many events one device loop
+    # iteration unrolls — the per-event draw mapping (and therefore every
+    # statistic) is bit-identical across K — so it stays out of the
+    # fingerprint (which also keeps pre-superstep checkpoints resumable).
+    fp_dict.pop("superstep", None)
     # The default generator is omitted so checkpoints from before the rng
     # field existed (identical threefry draws) still resume; non-default
     # generators fingerprint explicitly.
@@ -205,37 +209,21 @@ def run_simulation_config(
 
     t0 = time.monotonic()
     compile_s: float | None = None
-    while runs_done < config.runs:
-        this_batch = min(batch, config.runs - runs_done)
-        if mesh is not None and this_batch % n_dev != 0:
-            if engine_unsharded is None:
-                engine_unsharded = Engine(config, None)
-            this_engine = engine_unsharded
-        else:
-            this_engine = eng
-        if mesh is not None and jax.process_count() > 1:
-            # Multi-controller: assemble the batch keys shard-by-shard so they
-            # can live on a mesh containing non-addressable devices.
-            if config.rng != "threefry":
-                raise NotImplementedError(
-                    "rng='xoroshiro' is a single-controller A/B mode; "
-                    "multi-process runs use the default threefry sampling"
-                )
-            from .distributed import make_global_keys
+    last_done = t0
 
-            keys = make_global_keys(config.seed, runs_done, this_batch, mesh)
-        else:
-            keys = this_engine.make_keys(runs_done, this_batch)
-
-        batch_sums = None
+    def finalize_with_retries(fin, this_engine, keys, start: int):
+        """Block on an async batch and apply the retry/fallback policy; a
+        failed async finalize re-runs the batch synchronously."""
+        nonlocal eng
         attempts = 0
         while True:
             try:
-                with profiler.batch(this_batch) if profiler else contextlib.nullcontext():
-                    batch_sums = this_engine.run_batch(keys)
-                break
+                if fin is not None:
+                    out, fin = fin, None  # one shot: retries re-dispatch sync
+                    return out()
+                return this_engine.run_batch(keys)
             except Exception as e:  # noqa: BLE001 — batch-level retry is the point
-                if not (this_engine is eng and hasattr(this_engine, "scan_twin")) \
+                if not hasattr(this_engine, "scan_twin") \
                         and isinstance(e, (ValueError, TypeError)):
                     # Deterministic config errors (e.g. the int32 block-count
                     # guard) are not transient: fail fast instead of retrying.
@@ -244,7 +232,7 @@ def run_simulation_config(
                     # fallback below (where a config error re-raises instantly:
                     # run_batch validates before any device work).
                     raise
-                if this_engine is eng and hasattr(this_engine, "scan_twin"):
+                if hasattr(this_engine, "scan_twin"):
                     # Pallas kernel failed at compile/run time (e.g. a Mosaic
                     # lowering gap on this TPU generation): permanently fall
                     # back to the scan twin — same resolved chunk_steps, so
@@ -252,30 +240,83 @@ def run_simulation_config(
                     # is unchanged. Does not consume a retry attempt.
                     logger.exception(
                         "pallas engine failed at run %d; falling back to the scan engine",
-                        runs_done,
+                        start,
                     )
-                    eng = this_engine.scan_twin()
-                    this_engine = eng
+                    twin = this_engine.scan_twin()
+                    if this_engine is eng:
+                        eng = twin
+                    this_engine = twin
                     continue
                 attempts += 1
                 if attempts > max_retries:
                     raise
                 logger.exception(
-                    "batch at run %d failed (attempt %d); retrying", runs_done, attempts
+                    "batch at run %d failed (attempt %d); retrying", start, attempts
                 )
-        assert batch_sums is not None
 
-        if compile_s is None:
-            compile_s = time.monotonic() - t0
-        if sums is None:
-            sums = _zero_sums(batch_sums)
-        for k in sums:
-            sums[k] = sums[k] + batch_sums[k]
-        runs_done += this_batch
-        if ckpt is not None:
-            ckpt.save(runs_done, sums)
-        if progress is not None:
-            progress(runs_done, config.runs)
+    # Depth-1 pipelined batch loop: batch b+1 is dispatched (run_batch_async)
+    # BEFORE batch b is finalized, so the host-side work of b — the transfer,
+    # the float64 reduction, checkpoint write, progress callback and b+1's
+    # key construction — overlaps b+1's device compute instead of
+    # serializing with it. Statistics are order-identical to the sequential
+    # loop: batches still accumulate in dispatch order.
+    dispatched = runs_done
+    pending = None  # (finalize, keys, this_batch, engine, start_index)
+    while runs_done < config.runs or pending is not None:
+        nxt = None
+        if dispatched < config.runs:
+            this_batch = min(batch, config.runs - dispatched)
+            if mesh is not None and this_batch % n_dev != 0:
+                if engine_unsharded is None:
+                    engine_unsharded = Engine(config, None)
+                this_engine = engine_unsharded
+            else:
+                this_engine = eng
+            if mesh is not None and jax.process_count() > 1:
+                # Multi-controller: assemble the batch keys shard-by-shard so
+                # they can live on a mesh with non-addressable devices.
+                if config.rng != "threefry":
+                    raise NotImplementedError(
+                        "rng='xoroshiro' is a single-controller A/B mode; "
+                        "multi-process runs use the default threefry sampling"
+                    )
+                from .distributed import make_global_keys
+
+                keys = make_global_keys(config.seed, dispatched, this_batch, mesh)
+            else:
+                keys = this_engine.make_keys(dispatched, this_batch)
+            try:
+                fin = this_engine.run_batch_async(keys)
+            except Exception:  # noqa: BLE001 — retried at finalize time
+                logger.exception(
+                    "async dispatch at run %d failed; will retry synchronously",
+                    dispatched,
+                )
+                fin = None
+            nxt = (fin, keys, this_batch, this_engine, dispatched)
+            dispatched += this_batch
+
+        if pending is not None:
+            fin, keys_p, nb, eng_p, start = pending
+            batch_sums = finalize_with_retries(fin, eng_p, keys_p, start)
+            now = time.monotonic()
+            if profiler is not None:
+                # Completion-to-completion wall time: overlapped batches must
+                # not double-count the pipelined interval.
+                profiler.record(nb, now - last_done)
+            last_done = now
+            if compile_s is None:
+                compile_s = now - t0
+            if sums is None:
+                sums = _zero_sums(batch_sums)
+            for k in sums:
+                sums[k] = sums[k] + batch_sums[k]
+            runs_done += nb
+            if ckpt is not None:
+                ckpt.save(runs_done, sums)
+            if progress is not None:
+                progress(runs_done, config.runs)
+        pending = nxt
 
     elapsed = time.monotonic() - t0
     assert sums is not None

@@ -186,15 +186,18 @@ def run_sweep(
         }
         results.append(row)
         if out_path is not None:
-            with out_path.open("a+") as fh:
-                # A killed window can leave a truncated final line with no
-                # newline; appending directly would glue this row onto the
-                # fragment and make both unparseable.
-                fh.seek(0, 2)
-                if fh.tell() > 0:
-                    fh.seek(fh.tell() - 1)
-                    if fh.read(1) != "\n":
-                        fh.write("\n")
+            # A killed window can leave a truncated final line with no
+            # newline; appending directly would glue this row onto the
+            # fragment and make both unparseable. Probe/repair the trailing
+            # byte through a separate BINARY handle: text-mode tell() returns
+            # an opaque cookie on which arithmetic is undefined (io docs) and
+            # could mis-seek if a row ever contains non-ASCII.
+            if out_path.exists() and out_path.stat().st_size > 0:
+                with out_path.open("rb+") as bh:
+                    bh.seek(-1, 2)
+                    if bh.read(1) != b"\n":
+                        bh.write(b"\n")
+            with out_path.open("a") as fh:
                 fh.write(json.dumps(row) + "\n")
         if not quiet:
             print(f"[{name}] done in {row['elapsed_s']}s ({runs} runs)")
