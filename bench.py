@@ -112,7 +112,8 @@ def append_perf_rows(rows: list[dict], measurement: str) -> None:
 
 
 def pipelined_measure(engine, key_fn, batch: int, budget_s: float,
-                      max_batches: int, depth: int) -> tuple[int, float]:
+                      max_batches: int, depth: int,
+                      recorder=None) -> tuple[int, float]:
     """Depth-``depth`` pipelined measure loop: dispatch batch i+1 (keys from
     ``key_fn(i)``), then finalize batches until at most ``depth`` remain in
     flight, so host-side key construction and stat reduction overlap device
@@ -122,20 +123,45 @@ def pipelined_measure(engine, key_fn, batch: int, budget_s: float,
     time can overshoot the budget by up to ``depth + 1`` batch durations
     (the batch whose finalize reveals the budget is spent, plus the ones
     already in flight behind it) — size the batch to the budget on slow
-    hosts; the --hard-timeout watchdog bounds the worst case."""
+    hosts; the --hard-timeout watchdog bounds the worst case.
+
+    ``recorder`` (tpusim.telemetry.TelemetryRecorder) emits one ``batch``
+    span per finalize, completion-to-completion — the same schema as the
+    runner's pipelined batch loop, so `tpusim report` can render a bench
+    ledger and the telemetry-on-vs-off overhead is measured on the exact
+    span traffic production runs generate."""
     total_runs = 0
     inflight: list = []
     t0 = time.perf_counter()
+    last_done = t0
+
+    def finalize_one() -> None:
+        nonlocal total_runs, last_done
+        stall0 = time.perf_counter()
+        out = inflight.pop(0)()
+        now = time.perf_counter()
+        if recorder is not None:
+            recorder.emit(
+                "batch", t_start=time.time() - (now - last_done),
+                dur_s=now - last_done, runs=batch,
+                stall_s=round(now - stall0, 6),
+                reorg_depth_max=int(out["tele_reorg_depth_max"]),
+                stale_events=int(out["tele_stale_events_sum"]),
+                active_steps=int(out["tele_active_steps_sum"]),
+                chunks=int(out["tele_chunks_max"]),
+                step_slots=int(out["tele_chunks_max"]) * engine.chunk_steps * batch,
+            )
+        last_done = now
+        total_runs += batch
+
     for i in range(max_batches):
         inflight.append(engine.run_batch_async(key_fn(i)))
         while len(inflight) > depth:
-            inflight.pop(0)()
-            total_runs += batch
+            finalize_one()
         if time.perf_counter() - t0 >= budget_s:
             break
     while inflight:
-        inflight.pop(0)()
-        total_runs += batch
+        finalize_one()
     return total_runs, time.perf_counter() - t0
 
 
@@ -160,6 +186,10 @@ def main() -> int:
     ap.add_argument("--no-pipeline", action="store_true",
                     help="finalize each batch before dispatching the next "
                          "(the pre-pipelining measure loop, for ablation)")
+    ap.add_argument("--telemetry", default="",
+                    help="append a structured span ledger here "
+                         "(tpusim.telemetry; render with `tpusim report`): "
+                         "phase spans plus one batch span per measured batch")
     ap.add_argument("--ablate", type=int, default=0, metavar="N_CHUNKS",
                     help="instead of the headline, time N>=12 chained chunks "
                          "inside one jit per engine (the canonical "
@@ -280,6 +310,18 @@ def main() -> int:
         from tpusim.pallas_engine import FAST_TILE_RUNS, PallasEngine
         from tpusim.runner import make_engine, make_run_keys
 
+        recorder = None
+        if args.telemetry:
+            from tpusim.telemetry import TelemetryRecorder
+
+            recorder = TelemetryRecorder(args.telemetry)
+            info["telemetry"] = args.telemetry
+
+        def phase_span(name: str, dur_s: float, **attrs) -> None:
+            if recorder is not None:
+                recorder.emit(name, t_start=time.time() - dur_s, dur_s=dur_s,
+                              **attrs)
+
         def build_engine(config: SimConfig):
             if args.engine == "scan":
                 return Engine(config)
@@ -380,6 +422,7 @@ def main() -> int:
                 "blocks_found_total": int(sum(out["blocks_found_sum"])),
             }
             log(f"smoke: {info['smoke']}")
+            phase_span("smoke", compile_s + steady_s, **info["smoke"])
 
         # --- Phase: headline config.
         phase = "headline-build"
@@ -433,6 +476,8 @@ def main() -> int:
             engine.run_batch(make_run_keys(config.seed, 0, batch))
         info["warmup_s"] = round(time.monotonic() - t0, 2)
         log(f"warm-up done in {info['warmup_s']}s")
+        phase_span("headline_warmup", info["warmup_s"], engine=info["engine"],
+                   batch=batch)
 
         phase = "measure"
         # Pipelined measure loop: batch i+1 is dispatched before batch i is
@@ -444,8 +489,11 @@ def main() -> int:
         total_runs, elapsed = pipelined_measure(
             engine, lambda i: make_run_keys(config.seed, (i + 1) * batch, batch),
             batch, args.target_seconds, args.max_batches, depth,
+            recorder=recorder,
         )
         sim_years_per_s = total_runs * years_per_run / elapsed
+        phase_span("measure", elapsed, runs=total_runs, batch=batch,
+                   sim_years_per_s=round(sim_years_per_s, 3))
 
         def headline_payload() -> dict:
             return {
@@ -504,8 +552,11 @@ def main() -> int:
             total2, e_elapsed = pipelined_measure(
                 eng2, lambda i: make_run_keys(7, (i + 1) * ebatch, ebatch),
                 ebatch, args.exact_target_seconds, args.max_batches, depth,
+                recorder=recorder,
             )
             e_rate = total2 * years_per_run / e_elapsed
+            phase_span("exact_measure", e_elapsed, runs=total2, batch=ebatch,
+                       sim_years_per_s=round(e_rate, 3))
             einfo.update(
                 runs=total2,
                 elapsed_s=round(e_elapsed, 2),
@@ -554,6 +605,8 @@ def main() -> int:
                 append_perf_rows(
                     rows, "bench.py end-to-end headline (incl. dispatch)"
                 )
+        if recorder is not None:
+            recorder.close()
         done.set()
         emit_once(payload)
         return 0

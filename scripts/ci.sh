@@ -12,4 +12,22 @@ make -C native check
 echo "== pytest =="
 python -m pytest tests/ -q "$@"
 
+echo "== telemetry smoke =="
+# One tiny batch end-to-end through the telemetry path: the JSONL ledger must
+# parse and `tpusim report` must render it (exit 0) — the cheapest guard
+# against a span-schema or dashboard regression landing silently.
+tele_dir=$(mktemp -d)
+trap 'rm -rf "$tele_dir"' EXIT
+env JAX_PLATFORMS=cpu python -m tpusim --runs 4 --batch-size 4 \
+  --duration-ms 86400000 --single-device --quiet \
+  --telemetry "$tele_dir/smoke.jsonl"
+env JAX_PLATFORMS=cpu python - "$tele_dir/smoke.jsonl" <<'EOF'
+import sys
+from tpusim.telemetry import load_spans
+spans = load_spans(sys.argv[1])
+names = {s["span"] for s in spans}
+assert "batch" in names and "run" in names, names
+EOF
+env JAX_PLATFORMS=cpu python -m tpusim report "$tele_dir/smoke.jsonl" > /dev/null
+
 echo "== CI green =="

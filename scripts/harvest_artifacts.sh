@@ -33,4 +33,14 @@ if [ "${#selfish[@]}" -gt 0 ] || [ "${#hetero[@]}" -gt 0 ]; then
     python -m tpusim.analysis --out-dir artifacts/plots --only-selfish-grid \
     "${selfish[@]}" "${hetero[@]}"
 fi
+# Telemetry ledgers (--telemetry runs on hardware write here, or into /tmp on
+# the TPU host — tpu_watch.sh rsyncs them back): refresh the committed sample
+# dashboard from the newest ledger so the evidence trail stays renderable.
+mkdir -p artifacts/telemetry
+newest=$(ls -t artifacts/telemetry/*.jsonl 2>/dev/null | head -1 || true)
+if [ -n "$newest" ]; then
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m tpusim report "$newest" --format md \
+    --out artifacts/telemetry/sample_report.md > /dev/null
+fi
 git status --short BASELINE.json REFSCALE.md artifacts/

@@ -1,98 +1,19 @@
-"""Offline op-level attribution from an XLA/XProf device trace.
+"""Thin shim: the XLA trace-dir op attribution moved into ``tpusim.report``
+(the ``tpusim report`` subcommand renders both telemetry JSONL ledgers and
+trace directories). Kept so committed plan scripts and docs that call
+``python scripts/trace_report.py <dir>`` keep working.
 
-`python -m tpusim ... --trace-dir artifacts/trace_fast_r5` (run by
-scripts/tpu_r5b_plan.sh on hardware) writes a TensorBoard profile directory;
-this script needs no TensorBoard: it reads the chrome-trace JSON
-(`*.trace.json.gz`) inside, keeps the device-side tracks, and prints total
-time per op name — the post-split-slot step attribution that decides where
-the next kernel lever goes (BASELINE.md round-5 notes).
-
-    python scripts/trace_report.py artifacts/trace_fast_r5 [--top 25]
-
-Works on any trace dir produced by jax.profiler.trace / tpusim --trace-dir.
-Note: attribution is meaningful on DEVICE tracks (flat, non-overlapping op
-spans); host Python tracks nest caller inside callee, so their sums
-overcount — the tool prefers device tracks automatically when present.
+    python -m tpusim report artifacts/trace_fast_r5 [--top 25]
 """
 
 from __future__ import annotations
 
-import argparse
-import gzip
-import json
 import sys
-from collections import defaultdict
 from pathlib import Path
 
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-def find_trace_files(root: Path) -> list[Path]:
-    return sorted(root.rglob("*.trace.json.gz")) + sorted(root.rglob("*.trace.json"))
-
-
-def load_events(path: Path) -> list[dict]:
-    opener = gzip.open if path.suffix == ".gz" else open
-    with opener(path, "rt") as f:
-        data = json.load(f)
-    return data.get("traceEvents", data if isinstance(data, list) else [])
-
-
-def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("trace_dir", type=Path)
-    ap.add_argument("--top", type=int, default=25)
-    ap.add_argument("--track-filter", default="",
-                    help="only sum events whose process/track name contains "
-                         "this substring (default: prefer TPU/TensorCore "
-                         "tracks when present, else everything)")
-    args = ap.parse_args()
-
-    files = find_trace_files(args.trace_dir)
-    if not files:
-        print(f"no *.trace.json(.gz) under {args.trace_dir}", file=sys.stderr)
-        return 1
-
-    for path in files:
-        events = load_events(path)
-        # Map pid/tid to track names from metadata events.
-        proc_names: dict[int, str] = {}
-        for ev in events:
-            if ev.get("ph") == "M" and ev.get("name") == "process_name":
-                proc_names[ev.get("pid")] = ev.get("args", {}).get("name", "")
-
-        def track(ev: dict) -> str:
-            return proc_names.get(ev.get("pid"), "")
-
-        device_markers = ("TPU", "TensorCore", "Device", "/device:")
-        has_device = any(
-            any(m in name for m in device_markers) for name in proc_names.values()
-        )
-        wanted = args.track_filter or None
-
-        totals: dict[tuple[str, str], float] = defaultdict(float)
-        counts: dict[tuple[str, str], int] = defaultdict(int)
-        for ev in events:
-            if ev.get("ph") != "X":  # complete events carry durations
-                continue
-            name = track(ev)
-            if wanted is not None:
-                if wanted not in name:
-                    continue
-            elif has_device and not any(m in name for m in device_markers):
-                continue
-            key = (name, ev.get("name", "?"))
-            totals[key] += float(ev.get("dur", 0.0))
-            counts[key] += 1
-
-        grand = sum(totals.values())
-        print(f"\n== {path.relative_to(args.trace_dir)}  "
-              f"({len(events)} events, {grand / 1e3:.3f} ms summed on "
-              f"{'filtered' if wanted else ('device' if has_device else 'all')} tracks)")
-        for (name, op), us in sorted(totals.items(), key=lambda kv: -kv[1])[: args.top]:
-            pct = 100.0 * us / grand if grand else 0.0
-            print(f"  {us / 1e3:10.3f} ms  {pct:5.1f}%  x{counts[(name, op)]:<6d} "
-                  f"{op}  [{name}]")
-    return 0
-
+from tpusim.report import main  # noqa: E402
 
 if __name__ == "__main__":
     sys.exit(main())

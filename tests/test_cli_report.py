@@ -1,0 +1,339 @@
+"""Telemetry subsystem: recorder/ledger contract, device-side counters
+(scan vs pallas pinned equal), the CLI progress callback, and the
+``tpusim report`` dashboard subcommand.
+
+The counters are part of every run_batch output, so the existing engine
+equality suites pin them implicitly; the tests here pin the telemetry-
+specific contracts — JSONL schema, crash-tolerant read-back, span wiring
+through runner/sweep, report rendering for both input kinds, and the
+profiling satellites (single-batch steady flag, zero-spread guard).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import gzip
+import json
+import time
+
+import numpy as np
+import pytest
+
+from tpusim.config import SimConfig, default_network, reference_selfish_network
+from tpusim.engine import Engine, combine_sums
+from tpusim.runner import make_run_keys, run_simulation_config
+from tpusim.telemetry import (
+    BatchRecord,
+    TelemetryRecorder,
+    load_spans,
+    throughput_report,
+)
+
+SMALL = SimConfig(
+    network=default_network(propagation_ms=1000),
+    duration_ms=86_400_000,
+    runs=8,
+    batch_size=4,
+    seed=3,
+)
+
+
+# ---------------------------------------------------------------------------
+# Recorder / ledger contract.
+
+
+def test_recorder_schema_and_truncation_tolerance(tmp_path):
+    path = tmp_path / "t.jsonl"
+    rec = TelemetryRecorder(path)
+    rec.emit("batch", dur_s=1.5, runs=4, depth=np.int64(3))
+    with rec.span("checkpoint_save", runs_done=8) as attrs:
+        attrs["extra"] = "yes"
+    rec.close()
+    # Append garbage + a truncated line: load_spans must skip both, exactly
+    # like the sweep --resume scanner's tolerance policy.
+    with path.open("a") as fh:
+        fh.write("not json\n")
+        fh.write('{"run_id": "x", "span": "batc')
+    spans = load_spans(path)
+    assert [s["span"] for s in spans] == ["batch", "checkpoint_save"]
+    for s in spans:
+        assert set(s) >= {"run_id", "span", "t_start", "dur_s", "attrs"}
+        assert s["run_id"] == rec.run_id  # one correlating id per recorder
+    assert spans[0]["attrs"] == {"runs": 4, "depth": 3}  # np coerced to JSON int
+    assert spans[1]["attrs"]["extra"] == "yes"
+    assert spans[1]["dur_s"] >= 0.0
+
+
+def test_throughput_report_single_batch_is_flagged():
+    day = 86_400_000
+    multi = throughput_report(
+        [BatchRecord(4, 10.0), BatchRecord(4, 1.0)], day, 600.0
+    )
+    assert multi["steady_is_first_batch"] is False
+    assert multi["steady_runs_per_s"] == 4.0  # compile batch excluded
+    single = throughput_report([BatchRecord(4, 2.0)], day, 600.0)
+    # A single batch has only compile-contaminated numbers; they are still
+    # reported (better than nothing) but must carry the flag.
+    assert single["steady_is_first_batch"] is True
+    assert single["steady_runs_per_s"] == 2.0
+
+
+def test_profiler_is_thin_client_of_registry():
+    from tpusim.profiling import Profiler
+    from tpusim.telemetry import MetricsRegistry
+
+    prof = Profiler()
+    assert isinstance(prof.registry, MetricsRegistry)
+    prof.record(4, 2.0)
+    assert prof.records == prof.registry.batches  # same storage, no copy
+    rep = prof.report(86_400_000, 600.0)
+    assert rep["steady_is_first_batch"] is True
+    assert rep["trace_dir"] is None
+    # Identical derivation to the shared throughput_report.
+    shared = throughput_report(prof.registry.batches, 86_400_000, 600.0)
+    assert {k: v for k, v in rep.items() if k != "trace_dir"} == shared
+
+
+def test_time_chained_chunks_zero_best_guard(monkeypatch):
+    """A zero best timing (degenerate fast path / coarse clock) must yield
+    spread_pct None, not a ZeroDivisionError."""
+    from tpusim import profiling
+
+    config = dataclasses.replace(SMALL, runs=4, batch_size=4, chunk_steps=32)
+    engine = Engine(config)
+    keys = make_run_keys(config.seed, 0, 4)
+    monkeypatch.setattr(profiling.time, "perf_counter", lambda: 42.0)
+    r = profiling.time_chained_chunks(engine, keys, n_chunks=2, repeats=2)
+    assert r["spread_pct"] is None
+    assert r["s_per_chunk"] == 0.0
+    json.dumps(r)  # the JSONL artifact row must stay serializable
+    # roofline_point on the same degenerate timing: flagged row, no
+    # ZeroDivisionError aborting a multi-point sweep.
+    p = profiling.roofline_point(
+        engine, keys, bandwidth_gbps=1.0, n_chunks=2, repeats=2
+    )
+    assert p["degenerate_timing"] is True
+    assert p["events_per_s"] is None and p["fraction_of_roof"] is None
+    json.dumps(p)
+
+
+# ---------------------------------------------------------------------------
+# Device-side counters.
+
+
+def test_device_counters_scan_vs_pallas_equal():
+    """The kernel accumulates SimCounters from the same masks/operands as the
+    scan engine — pinned bit-equal here on the racy selfish config where all
+    three counters are busy (reorgs, stale events, mid-chunk freezes)."""
+    from tpusim.pallas_engine import PallasEngine
+
+    config = SimConfig(
+        network=reference_selfish_network(),
+        duration_ms=2 * 86_400_000,
+        runs=128,
+        batch_size=128,
+        mode="exact",
+        chunk_steps=64,
+        seed=23,
+    )
+    keys = make_run_keys(config.seed, 0, config.runs)
+    scan = Engine(config).run_batch(keys)
+    pallas = PallasEngine(config, tile_runs=128, step_block=32, interpret=True).run_batch(keys)
+    tele = [k for k in scan if k.startswith("tele_")]
+    assert sorted(tele) == [
+        "tele_active_steps_sum", "tele_chunks_max",
+        "tele_reorg_depth_max", "tele_stale_events_sum",
+    ]
+    for name in tele:
+        np.testing.assert_array_equal(
+            np.asarray(scan[name]), np.asarray(pallas[name]), err_msg=name
+        )
+    # Sanity on the semantics: a 40% selfish roster reorgs, so all three
+    # counters must be live, and occupancy is a fraction of executed slots.
+    assert int(scan["tele_reorg_depth_max"]) >= 1
+    assert int(scan["tele_stale_events_sum"]) >= 1
+    slots = int(scan["tele_chunks_max"]) * 64 * config.runs
+    occ = int(scan["tele_active_steps_sum"]) / slots
+    assert 0.0 < occ <= 1.0
+
+
+def test_combine_sums_merge_rule():
+    a = {"blocks_found_sum": np.array([2, 3]), "tele_reorg_depth_max": np.int64(5),
+         "tele_chunks_max": np.int64(7), "runs": np.int64(8)}
+    b = {"blocks_found_sum": np.array([1, 1]), "tele_reorg_depth_max": np.int64(9),
+         "tele_chunks_max": np.int64(4), "runs": np.int64(8)}
+    m = combine_sums(a, b)
+    assert m["blocks_found_sum"].tolist() == [3, 4]
+    assert int(m["tele_reorg_depth_max"]) == 9
+    assert int(m["tele_chunks_max"]) == 7
+    assert int(m["runs"]) == 16
+
+
+# ---------------------------------------------------------------------------
+# Runner/sweep span wiring.
+
+
+def test_runner_emits_correlated_spans(tmp_path):
+    led = tmp_path / "run.jsonl"
+    ck = tmp_path / "ck.npz"
+    rec = TelemetryRecorder(led)
+    run_simulation_config(
+        SMALL, use_all_devices=False, telemetry=rec, checkpoint_path=ck
+    )
+    rec.close()
+    spans = load_spans(led)
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["span"], []).append(s)
+    assert len(by_name["batch"]) == 2
+    assert len(by_name["checkpoint_save"]) == 2
+    assert len(by_name["run"]) == 1
+    assert len({s["run_id"] for s in spans}) == 1
+    batch = by_name["batch"][0]["attrs"]
+    assert set(batch) >= {
+        "start", "runs", "engine", "stall_s", "retries",
+        "reorg_depth_max", "stale_events", "active_steps", "chunks", "step_slots",
+    }
+    run = by_name["run"][0]["attrs"]
+    assert run["runs"] == SMALL.runs
+    assert run["duration_ms"] == SMALL.duration_ms
+    assert 0.0 < run["occupancy"] <= 1.0
+    # The run-level counters are the fold of the batch spans.
+    assert run["stale_events"] == sum(
+        s["attrs"]["stale_events"] for s in by_name["batch"]
+    )
+    assert run["reorg_depth_max"] == max(
+        s["attrs"]["reorg_depth_max"] for s in by_name["batch"]
+    )
+
+    # Resuming from the checkpoint emits a checkpoint_load span into the
+    # same ledger (new recorder, so a fresh run_id for the second run).
+    rec2 = TelemetryRecorder(led)
+    run_simulation_config(
+        dataclasses.replace(SMALL, runs=12), use_all_devices=False,
+        telemetry=rec2, checkpoint_path=ck,
+    )
+    rec2.close()
+    spans2 = load_spans(led)
+    loads = [s for s in spans2 if s["span"] == "checkpoint_load"]
+    assert len(loads) == 1 and loads[0]["attrs"]["runs_done"] == 8
+
+
+def test_sweep_telemetry_ledger(tmp_path):
+    from tpusim.sweep import run_sweep
+
+    led = tmp_path / "sweep.jsonl"
+    pts = [
+        ("p0", dataclasses.replace(SMALL, runs=4, batch_size=4)),
+        ("p1", dataclasses.replace(SMALL, runs=4, batch_size=4, seed=4)),
+    ]
+    run_sweep(pts, out_path=tmp_path / "out.jsonl", quiet=True, telemetry_path=led)
+    spans = load_spans(led)
+    points = [s for s in spans if s["span"] == "sweep_point"]
+    assert [s["attrs"]["point"] for s in points] == ["p0", "p1"]
+    # Backend batch spans share the sweep's run_id — one correlated ledger.
+    assert any(s["span"] == "batch" for s in spans)
+    assert len({s["run_id"] for s in spans}) == 1
+
+
+# ---------------------------------------------------------------------------
+# CLI: progress callback, --telemetry, and the report subcommand.
+
+
+def test_cli_progress_callback(capsys):
+    from tpusim.cli import main as cli_main
+
+    rc = cli_main(
+        ["--runs", "4", "--batch-size", "2", "--duration-ms", "86400000",
+         "--single-device"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    # The reference's stdout progress format (main.cpp:219), batch-granular:
+    # 2 of 4 runs -> 50%, then 100%.
+    assert "50% progress.." in out
+    assert "100% progress.." in out
+    assert "After running 4 simulations" in out
+
+
+def test_cli_telemetry_flag_and_report_subcommand(tmp_path, capsys):
+    from tpusim.cli import main as cli_main
+
+    led = tmp_path / "cli.jsonl"
+    rc = cli_main(
+        ["--runs", "4", "--batch-size", "2", "--duration-ms", "86400000",
+         "--single-device", "--quiet", "--telemetry", str(led)]
+    )
+    assert rc == 0
+    assert [s["span"] for s in load_spans(led)].count("batch") == 2
+    capsys.readouterr()
+
+    rc = cli_main(["report", str(led)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Phase breakdown" in out
+    assert "Throughput (batch spans)" in out
+    assert "stall histogram" in out
+    assert "Simulation counters" in out
+
+    md_out = tmp_path / "report.md"
+    rc = cli_main(["report", str(led), "--format", "md", "--out", str(md_out)])
+    assert rc == 0
+    assert md_out.read_text().startswith("# tpusim telemetry report")
+    assert "| span |" in md_out.read_text()
+
+    # Missing path: loud exit code, no traceback.
+    assert cli_main(["report", str(tmp_path / "nope.jsonl")]) == 2
+
+
+def test_report_multi_run_ledger_groups_throughput():
+    """An appended ledger holding several runs must derive throughput per
+    run_id: each run's compile (first) batch is excluded from its own steady
+    state, under its own duration_ms."""
+    from tpusim.report import render_report
+
+    spans = []
+    for rid in ("aaa", "bbb"):
+        spans.append({"run_id": rid, "span": "batch", "t_start": 0.0,
+                      "dur_s": 5.0, "attrs": {"runs": 4}})
+        spans.append({"run_id": rid, "span": "batch", "t_start": 5.0,
+                      "dur_s": 1.0, "attrs": {"runs": 4}})
+        spans.append({"run_id": rid, "span": "run", "t_start": 0.0, "dur_s": 6.0,
+                      "attrs": {"duration_ms": 86_400_000,
+                                "block_interval_s": 600.0}})
+    text = render_report(spans)
+    assert "Throughput — run aaa" in text
+    assert "Throughput — run bbb" in text
+    # Steady state excludes each run's own first batch: 4 runs / 1 s, twice
+    # (a pooled derivation would count run bbb's 5 s compile batch as steady).
+    assert text.count("4.0") >= 2
+    assert '"steady_is_first_batch"' not in text  # rendered as table rows
+    assert text.count("steady_runs_per_s") == 2
+
+
+def test_report_renders_trace_dir(tmp_path, capsys):
+    """The absorbed trace_report path: op attribution from a chrome-trace
+    dump, preferring device tracks over host ones."""
+    from tpusim.report import main as report_main
+
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/device:TPU:0 TensorCore"}},
+        {"ph": "M", "name": "process_name", "pid": 2,
+         "args": {"name": "python host"}},
+        {"ph": "X", "pid": 1, "name": "fusion.1", "dur": 700.0, "ts": 0},
+        {"ph": "X", "pid": 1, "name": "fusion.1", "dur": 300.0, "ts": 800},
+        {"ph": "X", "pid": 1, "name": "copy.2", "dur": 100.0, "ts": 1200},
+        {"ph": "X", "pid": 2, "name": "hostloop", "dur": 9999.0, "ts": 0},
+    ]
+    tdir = tmp_path / "trace" / "plugins" / "profile" / "run1"
+    tdir.mkdir(parents=True)
+    with gzip.open(tdir / "host.trace.json.gz", "wt") as fh:
+        json.dump({"traceEvents": events}, fh)
+    rc = report_main([str(tmp_path / "trace")])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "fusion.1" in out and "x2" in out
+    assert "copy.2" in out
+    assert "hostloop" not in out  # host track excluded when device tracks exist
+    assert "1.100 ms summed on device tracks" in out

@@ -95,17 +95,21 @@ def test_xoro_device_loop_matches_host_loop():
 
 def test_xoro_batch_split_is_batching_invariant():
     """Per-run streams are keyed by the GLOBAL run index, so two batches of 8
-    must sum to one batch of 16."""
+    must combine to one batch of 16 — additive stats sum, *_max telemetry
+    keys (deepest reorg, busy-chunk count) combine by max, i.e. exactly the
+    engine.combine_sums merge rule."""
     engine = Engine(TINY)
     whole = engine.run_batch(engine.make_keys(0, 16))
     a = engine.run_batch(engine.make_keys(0, 8))
     b = engine.run_batch(engine.make_keys(8, 8))
+    from tpusim.engine import combine_sums
+
+    merged = combine_sums(a, b)
     for name in whole:
         if name == "runs":
             continue
         np.testing.assert_allclose(
-            np.asarray(whole[name]),
-            np.asarray(a[name]) + np.asarray(b[name]),
+            np.asarray(whole[name]), np.asarray(merged[name]),
             rtol=1e-6, err_msg=name,
         )
 

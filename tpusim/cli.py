@@ -9,6 +9,11 @@ Examples:
     python -m tpusim --runs 1024 --propagation-ms 10000
     python -m tpusim --hashrates 40,19,12,11,8,5,3,1,1 --selfish 0
     python -m tpusim --config sweep.json --json out.json
+    python -m tpusim --runs 1024 --telemetry artifacts/telemetry/run.jsonl
+    python -m tpusim report artifacts/telemetry/run.jsonl --format md
+
+The ``report`` subcommand (tpusim.report) renders a ``--telemetry`` JSONL
+ledger — or a ``--trace-dir`` XLA trace directory — into a dashboard.
 """
 
 from __future__ import annotations
@@ -97,6 +102,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--trace-dir", type=Path, help="emit an XLA device trace here (TensorBoard format)"
     )
+    p.add_argument(
+        "--telemetry", type=Path, metavar="JSONL",
+        help="append structured run spans (batches, checkpoints, retries, "
+        "device-side sim counters) here; render with `tpusim report`",
+    )
     return p
 
 
@@ -145,6 +155,15 @@ def config_from_args(args: argparse.Namespace) -> SimConfig:
 
 
 def main(argv: list[str] | None = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "report":
+        # Subcommand dispatch ahead of the flat flag parser: the run flags
+        # and the report flags share no surface, and a bare leading "report"
+        # can never be a value of any run flag.
+        from .report import main as report_main
+
+        return report_main(argv[1:])
     args = build_parser().parse_args(argv)
     try:
         config = config_from_args(args)
@@ -157,10 +176,11 @@ def main(argv: list[str] | None = None) -> int:
                 "error: --checkpoint is only supported on the tpu backend; "
                 "the cpp oracle runs to completion in one call"
             )
-        if args.profile or args.trace_dir:
+        if args.profile or args.trace_dir or args.telemetry:
             raise SystemExit(
-                "error: --profile/--trace-dir instrument the tpu backend; "
-                "the cpp backend reports its own elapsed time in --json output"
+                "error: --profile/--trace-dir/--telemetry instrument the tpu "
+                "backend; the cpp backend reports its own elapsed time in "
+                "--json output"
             )
         if args.engine != "auto":
             raise SystemExit(
@@ -202,23 +222,37 @@ def main(argv: list[str] | None = None) -> int:
 
             profiler = Profiler(trace_dir=str(args.trace_dir) if args.trace_dir else None)
 
+        recorder = None
+        if args.telemetry:
+            from .telemetry import TelemetryRecorder
+
+            recorder = TelemetryRecorder(args.telemetry)
+
         from contextlib import nullcontext
 
-        with profiler.trace() if profiler else nullcontext():
-            results = run_simulation_config(
-                config,
-                use_all_devices=not args.single_device,
-                progress=None if args.quiet else progress,
-                checkpoint_path=args.checkpoint,
-                profiler=profiler,
-                engine=args.engine,
-                tile_runs=args.tile_runs,
-                step_block=args.step_block,
-            )
+        try:
+            with profiler.trace() if profiler else nullcontext():
+                results = run_simulation_config(
+                    config,
+                    use_all_devices=not args.single_device,
+                    progress=None if args.quiet else progress,
+                    checkpoint_path=args.checkpoint,
+                    profiler=profiler,
+                    telemetry=recorder,
+                    engine=args.engine,
+                    tile_runs=args.tile_runs,
+                    step_block=args.step_block,
+                )
+        finally:
+            if recorder is not None:
+                recorder.close()
         if not args.quiet:
             print()
         if profiler is not None and args.profile:
             print("[profile]", profiler.report_json(config.duration_ms, config.network.block_interval_s))
+        if recorder is not None and not args.quiet:
+            print(f"[telemetry] {args.telemetry} (run_id {recorder.run_id}; "
+                  f"render: python -m tpusim report {args.telemetry})")
     print(results.table())
     if results.overflow_total:
         print(f"  [diagnostics: {results.overflow_total} group-slot overflows]")

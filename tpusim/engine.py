@@ -36,6 +36,7 @@ serialized with the config and covered by the checkpoint fingerprint.
 from __future__ import annotations
 
 import math
+from typing import NamedTuple
 
 import numpy as np
 import jax
@@ -58,7 +59,10 @@ from .state import (
     rebase,
 )
 
-__all__ = ["Engine", "default_n_steps", "resolve_superstep", "DEFAULT_SUPERSTEP"]
+__all__ = [
+    "Engine", "SimCounters", "default_n_steps", "resolve_superstep",
+    "DEFAULT_SUPERSTEP",
+]
 
 #: Per-batch int32 block-count sums stay exact below this many blocks.
 _I32_SUM_GUARD = 2**31 - 1
@@ -91,6 +95,78 @@ def resolve_superstep(requested: int | None, divisor: int, *, exact: bool = Fals
     while divisor % k:
         k //= 2
     return max(k, 1)
+
+
+class SimCounters(NamedTuple):
+    """Device-side simulation telemetry, per run, accumulated event-by-event
+    in the carried aux tree — the counters ride the same HBM round trip as
+    the simulation state (scan carry / VMEM-resident kernel leaves), so
+    collecting them costs one O(M) reduction per event and 12 bytes per run
+    of extra traffic, invisible next to the ~KB state tree.
+
+    The scan engine and the Pallas kernel compute these from the same
+    quantities at the same program points, so they are pinned bit-equal by
+    tests (tests/test_cli_report.py) like every other output.
+    """
+
+    #: max over events of own blocks popped by a single reorg (the stale
+    #: increment of one adoption) — the depth proxy the O(1) representation
+    #: supports: lca heights are not tracked, own-block pops are.
+    reorg_max: jax.Array  # int32 []
+    #: events in which at least one block went stale (a reorg with losses).
+    stale_events: jax.Array  # int32 []
+    #: events for which this run was active (t < cap): occupancy numerator.
+    #: The complement is scan steps burned on a frozen run — the quantity
+    #: the chunk_steps sizing rationale above reasons about, now measured.
+    active_steps: jax.Array  # int32 []
+
+
+def init_counters() -> SimCounters:
+    z = jnp.zeros((), jnp.int32)
+    return SimCounters(z, z, z)
+
+
+def _count_step(ctr: SimCounters, old: SimState, new: SimState, cap: jax.Array) -> SimCounters:
+    """Fold one event into the counters from the state delta — ``stale`` only
+    moves in the notify reorg, so ``new.stale - old.stale`` is exactly the
+    per-miner pop count of this event's adoptions (zero when the sweep is
+    gated off or the run is frozen)."""
+    dmax = jnp.max(new.stale - old.stale)
+    return SimCounters(
+        reorg_max=jnp.maximum(ctr.reorg_max, dmax),
+        stale_events=ctr.stale_events + (dmax > 0).astype(jnp.int32),
+        active_steps=ctr.active_steps + (old.t < cap).astype(jnp.int32),
+    )
+
+
+#: run_batch output keys whose cross-batch (and head/tail split) merge is a
+#: max, not a sum — combine_sums() is the one merge rule for stat dicts.
+_MAX_KEYS_SUFFIX = "_max"
+
+
+def combine_sums(a: dict[str, np.ndarray], b: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+    """Merge two run_batch outputs over disjoint run sets: additive for the
+    stat sums, elementwise max for the ``*_max`` telemetry keys (a batch's
+    busy-chunk count / deepest reorg is the max over its runs, and run
+    behavior is batching-invariant under the counter-based RNG)."""
+    return {
+        k: np.maximum(a[k], b[k]) if k.endswith(_MAX_KEYS_SUFFIX) else a[k] + b[k]
+        for k in a
+    }
+
+
+def _host_reduce_telemetry(out: dict[str, np.ndarray], busy_chunks: int) -> None:
+    """Collapse the per-run counter leaves into the telemetry summary keys
+    (host-side int64: an int32 device sum of active_steps would overflow at
+    ~10k runs x 200k steps)."""
+    out["tele_reorg_depth_max"] = np.int64(np.max(out.pop("tele_reorg_depth_per_run")))
+    out["tele_stale_events_sum"] = np.int64(
+        out.pop("tele_stale_events_per_run").astype(np.int64).sum()
+    )
+    out["tele_active_steps_sum"] = np.int64(
+        out.pop("tele_active_steps_per_run").astype(np.int64).sum()
+    )
+    out["tele_chunks_max"] = np.int64(busy_chunks)
 
 
 def _host_reduce_sums(out: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
@@ -278,25 +354,27 @@ class Engine:
                 nbt = interval_ms_from_word(
                     ih, il, params.mean_interval_ms, float(INTERVAL_CAP)
                 )
-                return state._replace(next_block_time=nbt), (xi, xw)
+                return state._replace(next_block_time=nbt), (init_counters(), xi, xw)
 
             def chunk_fn(
                 state: SimState, aux, cap: jax.Array, run_key: jax.Array,
                 chunk_idx: jax.Array, params: SimParams,
             ):
-                xi, xw = aux
+                ctr, xi, xw = aux
 
                 def body(carry, _):
-                    st, xi, xw = carry
+                    st, xi, xw, ctr = carry
                     for _j in range(K):
+                        prev = st
                         st, xi, xw = _step_xoro(st, xi, xw, params, cap, any_selfish)
-                    return (st, xi, xw), None
+                        ctr = _count_step(ctr, prev, st, cap)
+                    return (st, xi, xw, ctr), None
 
-                (state, xi, xw), _ = jax.lax.scan(
-                    body, (state, xi, xw), None, length=steps // K
+                (state, xi, xw, ctr), _ = jax.lax.scan(
+                    body, (state, xi, xw, ctr), None, length=steps // K
                 )
                 state, elapsed = rebase(state)
-                return state, (xi, xw), elapsed
+                return state, (ctr, xi, xw), elapsed
         else:
 
             def init_fn(run_key: jax.Array, params: SimParams):
@@ -304,12 +382,13 @@ class Engine:
                 bits = jax.random.bits(jax.random.fold_in(run_key, 0), (2,), jnp.uint32)
                 return state._replace(
                     next_block_time=interval_from_bits(bits[1], params.mean_interval_ms)
-                ), ()
+                ), (init_counters(),)
 
             def chunk_fn(
                 state: SimState, aux, cap: jax.Array, run_key: jax.Array,
                 chunk_idx: jax.Array, params: SimParams,
             ):
+                (ctr,) = aux
                 key = jax.random.fold_in(run_key, 1 + chunk_idx)
                 # The (steps, 2) word block reshaped to (steps/K, K, 2): scan
                 # step s row j is word pair s*K + j — the same per-event
@@ -317,14 +396,17 @@ class Engine:
                 bits = jax.random.bits(key, (steps, 2), jnp.uint32)
                 bits = bits.reshape(steps // K, K, 2)
 
-                def body(carry: SimState, xs: jax.Array):
+                def body(carry, xs: jax.Array):
+                    st, ctr = carry
                     for j in range(K):
-                        carry = _step(carry, xs[j], params, cap, any_selfish)
-                    return carry, None
+                        prev = st
+                        st = _step(st, xs[j], params, cap, any_selfish)
+                        ctr = _count_step(ctr, prev, st, cap)
+                    return (st, ctr), None
 
-                state, _ = jax.lax.scan(body, state, bits)
+                (state, ctr), _ = jax.lax.scan(body, (state, ctr), bits)
                 state, elapsed = rebase(state)
-                return state, aux, elapsed
+                return state, (ctr,), elapsed
 
         def finalize_fn(state: SimState, t_end: jax.Array) -> dict[str, jax.Array]:
             per_run = jax.vmap(final_stats)(state, t_end)
@@ -432,6 +514,9 @@ class Engine:
                     "best_height_sum": P(), "overflow_sum": P(),
                     "blocks_share_per_run": P("runs"),
                     "stale_rate_per_run": P("runs"),
+                    "tele_reorg_depth_per_run": P("runs"),
+                    "tele_stale_events_per_run": P("runs"),
+                    "tele_active_steps_per_run": P("runs"),
                     "n_chunks": P(), "unfinished": P(),
                 }
 
@@ -541,6 +626,13 @@ class Engine:
             cond, body, (jnp.int32(0), state, aux, hi0, lo0)
         )
         sums = self._finalize_impl(state, hi * base + lo)
+        # Per-run telemetry counters out of the carried aux; reduced on the
+        # host like the ratio leaves (_host_reduce_telemetry) — an int32
+        # device sum of active_steps would overflow on large batches.
+        ctr: SimCounters = aux[0]
+        sums["tele_reorg_depth_per_run"] = ctr.reorg_max
+        sums["tele_stale_events_per_run"] = ctr.stale_events
+        sums["tele_active_steps_per_run"] = ctr.active_steps
         sums["n_chunks"] = i
         sums["unfinished"] = jnp.any((hi > 0) | (lo > 0))
         return sums
@@ -581,16 +673,24 @@ class Engine:
         hi, lo = self._ledger_init(n)
         state, aux = self._init(keys, self.params)
         flags: deque = deque()
+        # Chunks popped until (and including) the first all-done flag = the
+        # busy-chunk count of the device loop; the overshoot chunks the
+        # pipeline dispatched behind it are exact no-ops and stay uncounted,
+        # so tele_chunks_max is dispatch-path-invariant.
+        popped = 0
         finished = False
         for chunk_idx in range(self.max_chunks):
             state, aux, hi, lo, unfin = self._pipe_chunk(
                 state, aux, hi, lo, keys, jnp.asarray(chunk_idx, jnp.uint32), self.params
             )
             flags.append(unfin)
-            if len(flags) > self._PIPELINE_DEPTH and int(flags.popleft()) == 0:
-                finished = True
-                break
+            if len(flags) > self._PIPELINE_DEPTH:
+                popped += 1
+                if int(flags.popleft()) == 0:
+                    finished = True
+                    break
         while not finished and flags:
+            popped += 1
             finished = int(flags.popleft()) == 0
         if not finished:
             raise RuntimeError(
@@ -600,6 +700,11 @@ class Engine:
         t_end = hi * jnp.int32(self._LEDGER_BASE) + lo
         sums = self._finalize(state, t_end)
         out = _host_reduce_sums({k: np.asarray(v) for k, v in sums.items()})
+        ctr: SimCounters = aux[0]
+        out["tele_reorg_depth_per_run"] = np.asarray(ctr.reorg_max)
+        out["tele_stale_events_per_run"] = np.asarray(ctr.stale_events)
+        out["tele_active_steps_per_run"] = np.asarray(ctr.active_steps)
+        _host_reduce_telemetry(out, popped)
         out["runs"] = np.int64(n)
         return out
 
@@ -667,6 +772,9 @@ class Engine:
                     f"{self.chunk_steps} steps (limit {self.max_chunks}) — "
                     f"event count beyond the Poisson bound"
                 )
+            # n_chunks is already the busy-chunk count: the while cond admits
+            # only chunks with >= 1 unfinished run (pmax across mesh shards).
+            _host_reduce_telemetry(out, n_chunks)
             out["runs"] = np.int64(n)
             return out
 
@@ -738,5 +846,20 @@ class Engine:
         t_end = device_i32(remaining)
         sums = self._finalize(state, t_end)
         out = _host_reduce_sums({k: np.asarray(v) for k, v in sums.items()})
+        if multiproc:
+            # Non-addressable shards: telemetry reduces over this process's
+            # local runs only (the stat sums above are still global psums).
+            fetch = lambda arr: np.concatenate(
+                [np.asarray(s.data).ravel() for s in arr.addressable_shards]
+            )
+        else:
+            fetch = np.asarray
+        ctr: SimCounters = aux[0]
+        out["tele_reorg_depth_per_run"] = fetch(ctr.reorg_max)
+        out["tele_stale_events_per_run"] = fetch(ctr.stale_events)
+        out["tele_active_steps_per_run"] = fetch(ctr.active_steps)
+        # Every executed chunk had >= 1 active run (the loop breaks the
+        # moment all_done flips), so chunk_idx + 1 IS the busy-chunk count.
+        _host_reduce_telemetry(out, chunk_idx + 1)
         out["runs"] = np.int64(n)
         return out

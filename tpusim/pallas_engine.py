@@ -42,7 +42,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .config import SimConfig
-from .engine import Engine
+from .engine import Engine, SimCounters, combine_sums
 from .sampling import winner_thresholds32
 from .state import (
     INF_TIME,
@@ -77,6 +77,13 @@ _EXACT_LEAVES = (
     "t", "nbt", "bhp", "height", "npriv", "stale", "base", "garr", "gcnt",
     "cp", "ocp", "oin", "ocnt", "ovf",
 )
+#: Telemetry counter leaves (engine.SimCounters, runs-last), appended after
+#: the state leaves in the kernel's ref lists: per-run max single-reorg own
+#: pops, stale-event count, active steps. VMEM-resident like the state, so
+#: the per-event cost is one (M, R) reduction and no extra HBM traffic
+#: beyond 12 bytes per run per chunk. NOT part of _leaf_shapes: the roofline
+#: traffic model (profiling.state_bytes_per_run) counts simulation state.
+_TELE_LEAVES = ("mre", "sev", "act")
 
 
 def _leaf_shapes(m: int, k: int, exact: bool) -> list[tuple[int, ...]]:
@@ -101,7 +108,7 @@ def _make_kernel(
 
     def kernel(bits_ref, cap_ref, lo_ref, hi_ref, prop_ref, selfish_ref, *state_refs):
         ins, outs = state_refs[:n_state], state_refs[n_state:]
-        names = _EXACT_LEAVES if exact else _FAST_LEAVES
+        names = (_EXACT_LEAVES if exact else _FAST_LEAVES) + _TELE_LEAVES
 
         # First step block of this run tile: seed the VMEM-resident output
         # blocks from the inputs. They persist across the inner grid
@@ -324,8 +331,11 @@ def _make_kernel(
                 oc_b = jnp.sum(ocp * b32[None, :, :], axis=1)  # (M, R) own_cp[:, b]
             oc_bb = jnp.sum(oc_b * b32, axis=0, keepdims=True)
             oc_b = oc_b + b32 * (cnt_b - oc_bb)
-            # Own blocks above lca(:, b) — reorg stale accounting.
-            stale = stale + jnp.where(adopt, ocnt - oc_b, 0)
+            # Own blocks above lca(:, b) — reorg stale accounting. The
+            # per-miner pop count also feeds the telemetry counters below,
+            # exactly like the scan engine's stale delta (engine._count_step).
+            d_stale = jnp.where(adopt, ocnt - oc_b, 0)
+            stale = stale + d_stale
             row_b = jnp.sum(oin * b32[:, None, :], axis=0)  # (M, R) own_in[b, :]
             row_bb = jnp.sum(row_b * b32, axis=0, keepdims=True)
             row_b = row_b + b32 * (cnt_b - row_bb)
@@ -397,6 +407,14 @@ def _make_kernel(
                 earliest = jnp.min(pending, axis=(0, 1))[None, :]  # (1, R)
             t = jnp.where(active, jnp.maximum(jnp.minimum(nbt, earliest), t), t)
 
+            # Telemetry counters (engine.SimCounters semantics, bit-equal to
+            # the scan engine's by construction: same masks, same operands).
+            dmax = jnp.max(d_stale, axis=0, keepdims=True)  # (1, R)
+            st.update(
+                mre=jnp.maximum(st["mre"], dmax),
+                sev=st["sev"] + (dmax > 0).astype(I32),
+                act=st["act"] + active.astype(I32),
+            )
             st.update(t=t, nbt=nbt, height=height, stale=stale, base=base,
                       ovf=ovf, ocp=ocp, oin=oin, ocnt=ocnt)
             if split2:
@@ -604,7 +622,7 @@ class PallasEngine(Engine):
         tail = self.scan_twin().run_batch(
             keys[n - rem:], host_loop=host_loop, pipelined=pipelined
         )
-        return {k: head[k] + tail[k] for k in head}
+        return combine_sums(head, tail)
 
     def run_batch_async(self, keys):
         """Async dispatch only for whole-tile batches; a misaligned batch
@@ -675,7 +693,14 @@ class PallasEngine(Engine):
         )(keys)
 
         st = self._state_to_kernel(state)
+        # Telemetry counters ride as three extra (1, R) kernel leaves after
+        # the state (engine.SimCounters order: reorg_max, stale_events,
+        # active_steps), aliased in-out like every state leaf.
+        (ctr,) = aux
+        st = st + (ctr.reorg_max[None, :], ctr.stale_events[None, :],
+                   ctr.active_steps[None, :])
         shapes = [s + (n,) for s in _leaf_shapes(m, k, self.exact)]
+        shapes += [(1, n)] * 3
 
         def tile_spec(shape):
             block = shape[:-1] + (tile,)
@@ -716,5 +741,7 @@ class PallasEngine(Engine):
             interpret=self.interpret,
         )(bits, cap[None, :], self._lo, self._hi, self._prop, self._selfish, *st)
 
+        out, tele = out[: len(out) - 3], out[len(out) - 3:]
+        new_ctr = SimCounters(tele[0][0], tele[1][0], tele[2][0])
         new_state, elapsed = jax.vmap(rebase)(self._state_from_kernel(state, out))
-        return new_state, aux, elapsed
+        return new_state, (new_ctr,), elapsed

@@ -4,19 +4,24 @@ The reference's only observability is a progress percentage on stdout
 (reference main.cpp:219); SURVEY.md §5 mandates real telemetry for the TPU
 framework: compile-vs-run phase separation, steady-state throughput counters
 (sim-years/sec/chip — the headline unit of BASELINE.md), and device-level
-traces. This module provides both layers:
+traces. This module provides the host-timing layers on top of the shared
+sink in :mod:`tpusim.telemetry`:
 
-  * ``Profiler`` — host-side phase/batch accounting. The pipelined runner
-    times each device batch completion-to-completion and feeds the wall time
-    to ``profiler.record(n, elapsed_s)`` (a context manager around finalize
-    would double-count the dispatch/compute overlap); the report separates
-    the first batch (which pays XLA compilation) from steady-state batches
-    and derives runs/sec, sim-years/sec and events/sec.
+  * ``Profiler`` — host-side phase/batch accounting, now a thin client of
+    :class:`tpusim.telemetry.MetricsRegistry`: the registry stores the batch
+    records and :func:`tpusim.telemetry.throughput_report` derives the
+    report, so the ``--profile`` numbers and the ``tpusim report`` dashboard
+    share one implementation of "steady-state throughput". The pipelined
+    runner times each device batch completion-to-completion and feeds the
+    wall time to ``profiler.record(n, elapsed_s)`` (a context manager around
+    finalize would double-count the dispatch/compute overlap).
   * ``Profiler.trace`` — wraps ``jax.profiler.trace`` so a sweep can emit an
-    XLA device trace (viewable in TensorBoard/XProf) without any call-site
-    knowing profiler internals. No-op unless ``trace_dir`` is set.
+    XLA device trace (viewable in TensorBoard/XProf, or attributed offline
+    by ``tpusim report <trace-dir>``) without any call-site knowing profiler
+    internals. No-op unless ``trace_dir`` is set.
 
-Wired into the CLI as ``--profile`` / ``--trace-dir``.
+Wired into the CLI as ``--profile`` / ``--trace-dir``; structured JSONL
+spans are the CLI's ``--telemetry`` (tpusim.telemetry.TelemetryRecorder).
 """
 
 from __future__ import annotations
@@ -27,11 +32,7 @@ import json
 import time
 from typing import Any, Iterator
 
-
-@dataclasses.dataclass
-class BatchRecord:
-    runs: int
-    elapsed_s: float
+from .telemetry import BatchRecord, MetricsRegistry  # noqa: F401  (re-export)
 
 
 @dataclasses.dataclass
@@ -39,14 +40,18 @@ class Profiler:
     """Collects per-batch timings and derives throughput telemetry."""
 
     trace_dir: str | None = None
-    records: list[BatchRecord] = dataclasses.field(default_factory=list)
+    registry: MetricsRegistry = dataclasses.field(default_factory=MetricsRegistry)
+
+    @property
+    def records(self) -> list[BatchRecord]:
+        return self.registry.batches
 
     def record(self, runs: int, elapsed_s: float) -> None:
         """Record an externally-timed batch — the pipelined runner times each
         batch as completion-to-completion wall time (dispatch of batch c+1
         overlaps finalize of batch c, so a nested context manager would
         double-count the overlap)."""
-        self.records.append(BatchRecord(runs, elapsed_s))
+        self.registry.record_batch(runs, elapsed_s)
 
     @contextlib.contextmanager
     def trace(self) -> Iterator[None]:
@@ -60,29 +65,12 @@ class Profiler:
             yield
 
     def report(self, duration_ms: int, block_interval_s: float) -> dict[str, Any]:
-        """Phase timings + throughput. The first batch carries the jit
-        compilation (compile + first execution; JAX does not expose the split
-        without a trace); steady-state numbers use the remaining batches when
-        there are any."""
-        if not self.records:
-            return {"batches": 0}
-        total_runs = sum(r.runs for r in self.records)
-        total_s = sum(r.elapsed_s for r in self.records)
-        steady = self.records[1:] or self.records
-        steady_runs = sum(r.runs for r in steady)
-        steady_s = sum(r.elapsed_s for r in steady) or 1e-12
-        years_per_run = duration_ms / (365.2425 * 86_400_000.0)
-        events_per_run = 2.0 * duration_ms / (block_interval_s * 1000.0)
-        return {
-            "batches": len(self.records),
-            "total_runs": total_runs,
-            "total_s": round(total_s, 4),
-            "first_batch_s": round(self.records[0].elapsed_s, 4),
-            "steady_runs_per_s": round(steady_runs / steady_s, 3),
-            "steady_sim_years_per_s": round(steady_runs * years_per_run / steady_s, 3),
-            "steady_events_per_s": round(steady_runs * events_per_run / steady_s, 1),
-            "trace_dir": self.trace_dir,
-        }
+        """The registry's phase/throughput report (telemetry.throughput_report
+        — single-batch runs are flagged ``steady_is_first_batch``: their
+        "steady" numbers are compile-contaminated) plus the trace location."""
+        rep = self.registry.throughput(duration_ms, block_interval_s)
+        rep["trace_dir"] = self.trace_dir
+        return rep
 
     def report_json(self, duration_ms: int, block_interval_s: float) -> str:
         return json.dumps(self.report(duration_ms, block_interval_s), indent=2)
@@ -125,13 +113,19 @@ def time_chained_chunks(
             )
             return (state, aux)
 
-        state, _ = jax.lax.fori_loop(0, n_chunks, body, (state, aux))
+        state, aux = jax.lax.fori_loop(0, n_chunks, body, (state, aux))
         # A tiny output that depends on every run's state, forcing completion
         # without transferring the state tree. Must involve height/stale:
         # summing only state.t lets XLA algebraically cancel the rebase
         # (t - t = 0) and dead-code-eliminate the entire loop — observed on
-        # CPU as a 12-chunk program "running" in 46 us.
-        return jnp.sum(state.height) + jnp.sum(state.stale) + jnp.sum(state.t)
+        # CPU as a 12-chunk program "running" in 46 us. The telemetry
+        # counters (aux[0]) are folded in for the same reason: they are
+        # always-on in production batches, so a timing that let XLA
+        # dead-code-eliminate them would measure a program nobody runs.
+        forced = jnp.sum(state.height) + jnp.sum(state.stale) + jnp.sum(state.t)
+        for leaf in jax.tree_util.tree_leaves(aux[0]):
+            forced = forced + jnp.sum(leaf)
+        return forced
 
     prog(keys).block_until_ready()  # compile + warm
     times = []
@@ -141,6 +135,10 @@ def time_chained_chunks(
         times.append(time.perf_counter() - t0)
     best = min(times)
     steps = n_chunks * engine.chunk_steps
+    # A sub-resolution fast path (e.g. a dead-code-eliminated program, or a
+    # clock with coarse ticks) can return best == 0; the spread is undefined
+    # there, and None keeps the JSONL row parseable (inf is not valid JSON).
+    spread = round(100.0 * (max(times) - best) / best, 1) if best > 0 else None
     return {
         "engine": type(engine).__name__,
         "runs": int(n),
@@ -150,7 +148,7 @@ def time_chained_chunks(
         "s_per_chunk": round(best / n_chunks, 6),
         "us_per_step": round(best / steps * 1e6, 3),
         "repeats_s": [round(t, 4) for t in times],
-        "spread_pct": round(100.0 * (max(times) - best) / best, 1),
+        "spread_pct": spread,
     }
 
 
@@ -187,6 +185,10 @@ def bytes_per_event(engine) -> dict[str, float]:
       * ``pallas`` — state stays resident in VMEM across a whole chunk and
         crosses HBM once per chunk each way, so the per-event share is
         ``2 * state / chunk_steps``, plus the same 8 streamed RNG bytes.
+
+    The always-on telemetry counters (engine.SimCounters, 12 bytes per run)
+    are deliberately excluded: they are not simulation state and sit three
+    orders of magnitude under the state tree in both traffic models.
     """
     sb = state_bytes_per_run(engine)
     return {
@@ -231,16 +233,26 @@ def roofline_point(
     kind = "pallas" if isinstance(engine, PallasEngine) else "scan"
     per_event = model[kind]
     n = int(keys.shape[0])
-    events_per_s = n / (timing["us_per_step"] * 1e-6)
     roof = bandwidth_gbps * 1e9 / per_event
-    return {
+    row = {
         **timing,
         "mode": engine.config.resolved_mode,
         "traffic_model": kind,
         "state_bytes_per_run": model["state_bytes_per_run"],
         "bytes_per_event": round(per_event, 2),
-        "events_per_s": round(events_per_s, 1),
         "bandwidth_gbps": round(bandwidth_gbps, 2),
         "roof_events_per_s": round(roof, 1),
-        "fraction_of_roof": round(events_per_s / roof, 4),
     }
+    if timing["us_per_step"] <= 0:
+        # Same degenerate fast path time_chained_chunks guards spread_pct
+        # against: a sub-resolution timing makes the rates meaningless, and a
+        # raw division here would abort a whole multi-point sweep with a
+        # ZeroDivisionError. Flag the row instead; measure sweeps drop it.
+        row.update(events_per_s=None, fraction_of_roof=None, degenerate_timing=True)
+        return row
+    events_per_s = n / (timing["us_per_step"] * 1e-6)
+    row.update(
+        events_per_s=round(events_per_s, 1),
+        fraction_of_roof=round(events_per_s / roof, 4),
+    )
+    return row

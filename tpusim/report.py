@@ -1,0 +1,348 @@
+"""``tpusim report`` — render a telemetry ledger into a dashboard.
+
+Two input kinds, auto-detected:
+
+  * a telemetry **JSONL file** written by ``--telemetry`` (tpusim.telemetry):
+    rendered into a terminal/markdown dashboard — phase breakdown, steady-
+    state throughput (the same derivation as ``Profiler.report``:
+    telemetry.throughput_report), a pipelined-dispatch stall histogram, and
+    the device-side simulation counters (max reorg depth, stale events,
+    active-step occupancy) aggregated over every batch span;
+  * an XLA **trace directory** written by ``--trace-dir``: offline op-level
+    time attribution from the chrome-trace JSON inside — no TensorBoard
+    needed (absorbed from the former scripts/trace_report.py; that script is
+    now a thin shim over this module). Attribution is meaningful on DEVICE
+    tracks (flat, non-overlapping op spans); host Python tracks nest caller
+    inside callee, so their sums overcount — device tracks are preferred
+    automatically when present.
+
+    python -m tpusim report artifacts/telemetry/run.jsonl [--format md]
+    python -m tpusim report artifacts/trace_fast_r5 [--top 25]
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import json
+import sys
+from collections import defaultdict
+from pathlib import Path
+from typing import Any
+
+from .telemetry import BatchRecord, load_spans, throughput_report
+
+__all__ = ["render_report", "trace_attribution", "main"]
+
+
+# ---------------------------------------------------------------------------
+# Telemetry JSONL dashboard.
+
+#: Stall histogram bucket upper bounds in seconds (log-ish ladder); the last
+#: bucket is open-ended.
+_STALL_BUCKETS = (0.001, 0.01, 0.1, 1.0, 10.0)
+
+
+def _fmt_s(s: float) -> str:
+    return f"{s * 1e3:.1f} ms" if s < 1.0 else f"{s:.2f} s"
+
+
+def _bar(count: int, peak: int, width: int = 24) -> str:
+    if peak <= 0:
+        return ""
+    return "#" * max(1, round(width * count / peak)) if count else ""
+
+
+def _stall_histogram(stalls: list[float]) -> list[tuple[str, int]]:
+    edges = [0.0, *_STALL_BUCKETS, float("inf")]
+    labels = []
+    counts = []
+    for lo, hi in zip(edges[:-1], edges[1:]):
+        n = sum(1 for s in stalls if lo <= s < hi)
+        hi_lbl = "inf" if hi == float("inf") else _fmt_s(hi)
+        labels.append(f"{_fmt_s(lo)} - {hi_lbl}" if lo else f"< {hi_lbl}")
+        counts.append(n)
+    return list(zip(labels, counts))
+
+
+def _phase_rows(spans: list[dict]) -> list[tuple[str, int, float]]:
+    """(span name, count, total duration) sorted by total duration."""
+    totals: dict[str, float] = defaultdict(float)
+    counts: dict[str, int] = defaultdict(int)
+    for sp in spans:
+        totals[sp["span"]] += float(sp.get("dur_s", 0.0))
+        counts[sp["span"]] += 1
+    return sorted(
+        ((name, counts[name], totals[name]) for name in totals),
+        key=lambda row: -row[2],
+    )
+
+
+def _batch_aggregates(batches: list[dict]) -> dict[str, Any] | None:
+    """Fold the device-side counters riding in batch-span attrs into the
+    run-level summary (max of maxes, sum of sums, traffic-weighted
+    occupancy). Batches recorded without counters (e.g. a foreign emitter)
+    simply don't contribute."""
+    agg: dict[str, Any] = {
+        "reorg_depth_max": 0, "stale_events": 0,
+        "active_steps": 0, "step_slots": 0, "retries": 0,
+    }
+    seen = False
+    for sp in batches:
+        attrs = sp.get("attrs", {})
+        if "reorg_depth_max" in attrs:
+            seen = True
+            agg["reorg_depth_max"] = max(agg["reorg_depth_max"], int(attrs["reorg_depth_max"]))
+            agg["stale_events"] += int(attrs.get("stale_events", 0))
+            agg["active_steps"] += int(attrs.get("active_steps", 0))
+            agg["step_slots"] += int(attrs.get("step_slots", 0))
+        agg["retries"] += int(attrs.get("retries", 0))
+    if not seen:
+        return None
+    agg["occupancy"] = (
+        agg["active_steps"] / agg["step_slots"] if agg["step_slots"] else None
+    )
+    return agg
+
+
+def render_report(spans: list[dict], fmt: str = "text") -> str:
+    """The dashboard string for one telemetry ledger (``fmt``: text | md)."""
+    md = fmt == "md"
+    out: list[str] = []
+
+    def heading(text: str) -> None:
+        if md:
+            out.append(f"\n## {text}\n")
+        else:
+            out.append(f"\n== {text} ==")
+
+    def table(headers: list[str], rows: list[list[str]]) -> None:
+        if md:
+            out.append("| " + " | ".join(headers) + " |")
+            out.append("|" + "|".join("---" for _ in headers) + "|")
+            for r in rows:
+                out.append("| " + " | ".join(r) + " |")
+        else:
+            widths = [
+                max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+                for i, h in enumerate(headers)
+            ]
+            out.append("  " + "  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+            for r in rows:
+                out.append("  " + "  ".join(c.ljust(w) for c, w in zip(r, widths)))
+
+    if not spans:
+        return "telemetry ledger is empty (no parseable spans)\n"
+
+    run_ids = sorted({sp.get("run_id", "?") for sp in spans})
+    t0 = min(sp.get("t_start", 0.0) for sp in spans)
+    t1 = max(sp.get("t_start", 0.0) + sp.get("dur_s", 0.0) for sp in spans)
+    title = "tpusim telemetry report"
+    out.append(f"# {title}" if md else title)
+    out.append(
+        f"{len(spans)} spans, run_id{'s' if len(run_ids) > 1 else ''} "
+        f"{', '.join(run_ids)}, wall window {t1 - t0:.2f} s"
+    )
+
+    heading("Phase breakdown")
+    rows = _phase_rows(spans)
+    grand = sum(r[2] for r in rows) or 1e-12
+    table(
+        ["span", "count", "total", "share"],
+        [
+            [name, str(cnt), _fmt_s(tot), f"{100 * tot / grand:.1f}%"]
+            for name, cnt, tot in rows
+        ],
+    )
+
+    batches = [sp for sp in spans if sp["span"] == "batch"]
+    if batches:
+        # An appended ledger can hold several runs (repeated --telemetry to
+        # one file); throughput must derive per run_id — the first-batch
+        # (compile) exclusion and the duration_ms lookup are per-run facts,
+        # and mixing runs would count every later run's compile batch as
+        # steady state.
+        run_attrs = {
+            sp.get("run_id"): sp.get("attrs", {})
+            for sp in spans if sp["span"] == "run"
+        }
+        groups: dict[str, list[dict]] = {}
+        for sp in batches:
+            groups.setdefault(sp.get("run_id", "?"), []).append(sp)
+        for rid, group in groups.items():
+            heading(
+                "Throughput (batch spans)" if len(groups) == 1
+                else f"Throughput — run {rid}"
+            )
+            records = [
+                BatchRecord(int(sp["attrs"].get("runs", 0)), float(sp["dur_s"]))
+                for sp in group
+            ]
+            a = run_attrs.get(rid, {})
+            # duration_ms/block_interval_s ride on the run span; without one
+            # (partial ledger) only run-rate is derivable.
+            if "duration_ms" in a:
+                rep = throughput_report(
+                    records, int(a["duration_ms"]), float(a["block_interval_s"])
+                )
+            else:
+                rep = throughput_report(records, 0, 600.0)
+                rep.pop("steady_sim_years_per_s", None)
+                rep.pop("steady_events_per_s", None)
+            table(
+                ["metric", "value"],
+                [[k, json.dumps(v)] for k, v in rep.items()],
+            )
+
+        stalls = [
+            float(sp["attrs"]["stall_s"])
+            for sp in batches
+            if "stall_s" in sp.get("attrs", {})
+        ]
+        if stalls:
+            heading("Pipelined-dispatch stall histogram")
+            hist = _stall_histogram(stalls)
+            peak = max(c for _, c in hist)
+            table(
+                ["stall", "batches", ""],
+                [[lbl, str(c), _bar(c, peak)] for lbl, c in hist],
+            )
+
+        agg = _batch_aggregates(batches)
+        if agg is not None:
+            heading("Simulation counters (device-side)")
+            occ = agg["occupancy"]
+            table(
+                ["counter", "value"],
+                [
+                    ["max reorg depth (own blocks popped, single reorg)",
+                     str(agg["reorg_depth_max"])],
+                    ["stale events (events losing >=1 block)",
+                     str(agg["stale_events"])],
+                    ["active step occupancy (active / executed step slots)",
+                     f"{occ:.4f}" if occ is not None else "n/a"],
+                    ["batch retries", str(agg["retries"])],
+                ],
+            )
+
+    points = [sp for sp in spans if sp["span"] == "sweep_point"]
+    if points:
+        heading("Sweep points")
+        table(
+            ["point", "runs", "elapsed"],
+            [
+                [str(sp["attrs"].get("point", "?")),
+                 str(sp["attrs"].get("runs", "?")), _fmt_s(float(sp["dur_s"]))]
+                for sp in points
+            ],
+        )
+
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# XLA trace-dir op attribution (absorbed from scripts/trace_report.py).
+
+
+def find_trace_files(root: Path) -> list[Path]:
+    return sorted(root.rglob("*.trace.json.gz")) + sorted(root.rglob("*.trace.json"))
+
+
+def _load_trace_events(path: Path) -> list[dict]:
+    opener = gzip.open if path.suffix == ".gz" else open
+    with opener(path, "rt") as f:
+        data = json.load(f)
+    return data.get("traceEvents", data if isinstance(data, list) else [])
+
+
+def trace_attribution(
+    trace_dir: Path, top: int = 25, track_filter: str = ""
+) -> str:
+    """Total device time per op name for every chrome-trace file under
+    ``trace_dir`` (the --trace-dir output), as a printable table."""
+    files = find_trace_files(trace_dir)
+    if not files:
+        return f"no *.trace.json(.gz) under {trace_dir}\n"
+
+    out: list[str] = []
+    for path in files:
+        events = _load_trace_events(path)
+        proc_names: dict[int, str] = {}
+        for ev in events:
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                proc_names[ev.get("pid")] = ev.get("args", {}).get("name", "")
+
+        device_markers = ("TPU", "TensorCore", "Device", "/device:")
+        has_device = any(
+            any(m in name for m in device_markers) for name in proc_names.values()
+        )
+        wanted = track_filter or None
+
+        totals: dict[tuple[str, str], float] = defaultdict(float)
+        counts: dict[tuple[str, str], int] = defaultdict(int)
+        for ev in events:
+            if ev.get("ph") != "X":  # complete events carry durations
+                continue
+            name = proc_names.get(ev.get("pid"), "")
+            if wanted is not None:
+                if wanted not in name:
+                    continue
+            elif has_device and not any(m in name for m in device_markers):
+                continue
+            key = (name, ev.get("name", "?"))
+            totals[key] += float(ev.get("dur", 0.0))
+            counts[key] += 1
+
+        grand = sum(totals.values())
+        out.append(
+            f"\n== {path.relative_to(trace_dir)}  "
+            f"({len(events)} events, {grand / 1e3:.3f} ms summed on "
+            f"{'filtered' if wanted else ('device' if has_device else 'all')} tracks)"
+        )
+        for (name, op), us in sorted(totals.items(), key=lambda kv: -kv[1])[:top]:
+            pct = 100.0 * us / grand if grand else 0.0
+            out.append(
+                f"  {us / 1e3:10.3f} ms  {pct:5.1f}%  x{counts[(name, op)]:<6d} "
+                f"{op}  [{name}]"
+            )
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpusim report",
+        description="Render a telemetry JSONL (or an XLA trace dir) into a dashboard.",
+    )
+    ap.add_argument("path", type=Path, help="telemetry .jsonl file, or a --trace-dir directory")
+    ap.add_argument("--format", choices=("text", "md"), default="text")
+    ap.add_argument("--out", type=Path, help="also write the rendered report here")
+    ap.add_argument("--top", type=int, default=25, help="trace mode: ops to show")
+    ap.add_argument(
+        "--track-filter", default="",
+        help="trace mode: only sum events whose track name contains this "
+        "substring (default: prefer TPU/TensorCore tracks when present)",
+    )
+    args = ap.parse_args(argv)
+
+    if not args.path.exists():
+        print(f"error: {args.path} does not exist", file=sys.stderr)
+        return 2
+    if args.path.is_dir():
+        text = trace_attribution(args.path, top=args.top, track_filter=args.track_filter)
+    else:
+        text = render_report(load_spans(args.path), fmt=args.format)
+    try:
+        print(text, end="", flush=True)
+    except BrokenPipeError:
+        pass  # `tpusim report ... | head` closing stdout early is not an error
+    if args.out:
+        args.out.parent.mkdir(parents=True, exist_ok=True)
+        args.out.write_text(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,7 @@ from .config import SimConfig
 from .engine import Engine
 from .profiling import Profiler
 from .stats import SimResults
+from .telemetry import TelemetryRecorder
 
 logger = logging.getLogger("tpusim")
 
@@ -138,6 +139,7 @@ def run_simulation_config(
     checkpoint_path: str | Path | None = None,
     max_retries: int = 2,
     profiler: "Profiler | None" = None,
+    telemetry: "TelemetryRecorder | None" = None,
     engine: str = "auto",
     tile_runs: int | None = None,
     step_block: int | None = None,
@@ -152,6 +154,14 @@ def run_simulation_config(
     engine: "pallas" (raises on an ineligible config, falls back to the
     draw-identical scan twin only on a runtime kernel failure), "scan", or
     "auto" (the platform default of :func:`make_engine`).
+
+    ``telemetry`` records the structured span ledger (tpusim.telemetry): one
+    ``batch`` span per device batch — completion-to-completion wall time,
+    host stall while blocked on the device, retry count, and the device-side
+    simulation counters the engines accumulate in their carried aux
+    (engine.SimCounters) — plus ``checkpoint_load``/``checkpoint_save``,
+    ``retry``/``engine_fallback`` events, and one closing ``run`` span with
+    the aggregated totals. Render with ``python -m tpusim report``.
     """
     if engine not in ("auto", "pallas", "scan"):
         raise ValueError(f"unknown engine {engine!r}; use auto, pallas or scan")
@@ -203,25 +213,41 @@ def run_simulation_config(
     fingerprint = json.dumps(fp_dict, sort_keys=True)
     ckpt = _Checkpoint(Path(checkpoint_path), fingerprint) if checkpoint_path else None
     runs_done, sums = 0, None
-    if ckpt is not None and (loaded := ckpt.load()) is not None:
-        runs_done, sums = loaded
-        logger.info("resuming from checkpoint at %d/%d runs", runs_done, config.runs)
+    if ckpt is not None:
+        t_ld = time.perf_counter()
+        loaded = ckpt.load()
+        if loaded is not None:
+            runs_done, sums = loaded
+            logger.info("resuming from checkpoint at %d/%d runs", runs_done, config.runs)
+            if telemetry is not None:
+                telemetry.emit(
+                    "checkpoint_load", dur_s=time.perf_counter() - t_ld,
+                    runs_done=runs_done, path=str(ckpt.path),
+                )
 
     t0 = time.monotonic()
     compile_s: float | None = None
     last_done = t0
+    # Run-level totals of the per-batch device counters (engine.SimCounters
+    # reductions), reported on the closing "run" span and mirrored in every
+    # "batch" span's attrs.
+    tele_run = {"reorg_depth_max": 0, "stale_events": 0, "active_steps": 0,
+                "step_slots": 0, "retries": 0}
 
     def finalize_with_retries(fin, this_engine, keys, start: int):
         """Block on an async batch and apply the retry/fallback policy; a
-        failed async finalize re-runs the batch synchronously."""
+        failed async finalize re-runs the batch synchronously. Returns
+        (sums, attempts, engine) — the engine that actually produced the
+        result, so after a pallas->scan fallback the batch span attributes
+        the throughput to the engine that ran, not the one that failed."""
         nonlocal eng
         attempts = 0
         while True:
             try:
                 if fin is not None:
                     out, fin = fin, None  # one shot: retries re-dispatch sync
-                    return out()
-                return this_engine.run_batch(keys)
+                    return out(), attempts, this_engine
+                return this_engine.run_batch(keys), attempts, this_engine
             except Exception as e:  # noqa: BLE001 — batch-level retry is the point
                 if not hasattr(this_engine, "scan_twin") \
                         and isinstance(e, (ValueError, TypeError)):
@@ -242,12 +268,18 @@ def run_simulation_config(
                         "pallas engine failed at run %d; falling back to the scan engine",
                         start,
                     )
+                    if telemetry is not None:
+                        telemetry.emit("engine_fallback", start=start, error=repr(e)[:200])
                     twin = this_engine.scan_twin()
                     if this_engine is eng:
                         eng = twin
                     this_engine = twin
                     continue
                 attempts += 1
+                if telemetry is not None:
+                    telemetry.emit(
+                        "retry", start=start, attempt=attempts, error=repr(e)[:200]
+                    )
                 if attempts > max_retries:
                     raise
                 logger.exception(
@@ -298,12 +330,49 @@ def run_simulation_config(
 
         if pending is not None:
             fin, keys_p, nb, eng_p, start = pending
-            batch_sums = finalize_with_retries(fin, eng_p, keys_p, start)
+            t_stall = time.perf_counter()
+            batch_sums, attempts, eng_p = finalize_with_retries(fin, eng_p, keys_p, start)
+            # Host time blocked waiting for the device: the pipelined-
+            # dispatch stall. Near-zero while the pipeline keeps the device
+            # ahead of the host; one batch duration when it does not.
+            stall_s = time.perf_counter() - t_stall
             now = time.monotonic()
             if profiler is not None:
                 # Completion-to-completion wall time: overlapped batches must
                 # not double-count the pipelined interval.
                 profiler.record(nb, now - last_done)
+            # The device-side counters ride the batch sums but aggregate by
+            # max/sum rather than into SimResults: strip them before the
+            # stat accumulation (checkpoint schema unchanged) and report
+            # them through the telemetry ledger instead.
+            tele_b = {k: batch_sums.pop(k) for k in list(batch_sums)
+                      if k.startswith("tele_")}
+            if tele_b:
+                step_slots = (
+                    int(tele_b["tele_chunks_max"]) * eng_p.chunk_steps * nb
+                )
+                tele_run["reorg_depth_max"] = max(
+                    tele_run["reorg_depth_max"], int(tele_b["tele_reorg_depth_max"])
+                )
+                tele_run["stale_events"] += int(tele_b["tele_stale_events_sum"])
+                tele_run["active_steps"] += int(tele_b["tele_active_steps_sum"])
+                tele_run["step_slots"] += step_slots
+            tele_run["retries"] += attempts
+            if telemetry is not None:
+                dur = now - last_done
+                attrs = dict(
+                    start=start, runs=nb, engine=type(eng_p).__name__,
+                    stall_s=round(stall_s, 6), retries=attempts,
+                )
+                if tele_b:
+                    attrs.update(
+                        reorg_depth_max=int(tele_b["tele_reorg_depth_max"]),
+                        stale_events=int(tele_b["tele_stale_events_sum"]),
+                        active_steps=int(tele_b["tele_active_steps_sum"]),
+                        chunks=int(tele_b["tele_chunks_max"]),
+                        step_slots=step_slots,
+                    )
+                telemetry.emit("batch", t_start=time.time() - dur, dur_s=dur, **attrs)
             last_done = now
             if compile_s is None:
                 compile_s = now - t0
@@ -313,13 +382,32 @@ def run_simulation_config(
                 sums[k] = sums[k] + batch_sums[k]
             runs_done += nb
             if ckpt is not None:
+                t_ck = time.perf_counter()
                 ckpt.save(runs_done, sums)
+                if telemetry is not None:
+                    telemetry.emit(
+                        "checkpoint_save", dur_s=time.perf_counter() - t_ck,
+                        runs_done=runs_done, path=str(ckpt.path),
+                    )
             if progress is not None:
                 progress(runs_done, config.runs)
         pending = nxt
 
     elapsed = time.monotonic() - t0
     assert sums is not None
+    if telemetry is not None:
+        occupancy = (
+            tele_run["active_steps"] / tele_run["step_slots"]
+            if tele_run["step_slots"] else None
+        )
+        telemetry.emit(
+            "run", t_start=time.time() - elapsed, dur_s=elapsed,
+            runs=runs_done, duration_ms=config.duration_ms,
+            block_interval_s=config.network.block_interval_s,
+            batch_size=batch, mode=config.resolved_mode,
+            engine=type(eng).__name__, compile_s=round(compile_s or 0.0, 4),
+            occupancy=occupancy, **tele_run,
+        )
     return SimResults.from_sums(
         sums, config, mode=config.resolved_mode, elapsed_s=elapsed, compile_s=compile_s
     )

@@ -123,6 +123,7 @@ def run_sweep(
     checkpoint_dir: Path | None = None,
     quiet: bool = False,
     resume: bool = False,
+    telemetry_path: Path | None = None,
 ) -> list[dict]:
     """Run every point; returns (and optionally appends as JSONL) result dicts.
 
@@ -133,6 +134,11 @@ def run_sweep(
     interrupted hardware window fills exactly the missing points (in-progress
     per-point state is picked up from ``checkpoint_dir`` as usual) without
     appending duplicate rows for finished ones.
+
+    ``telemetry_path`` appends one structured span ledger for the whole
+    sweep (tpusim.telemetry): a ``sweep_point`` span per point sharing one
+    run_id, with the tpu backend's per-batch spans interleaved under the
+    same id — render with ``python -m tpusim report``.
     """
     import dataclasses
 
@@ -158,6 +164,12 @@ def run_sweep(
             except (json.JSONDecodeError, KeyError):
                 continue
 
+    recorder = None
+    if telemetry_path is not None:
+        from .telemetry import TelemetryRecorder
+
+        recorder = TelemetryRecorder(telemetry_path)
+
     results = []
     for name, config in points:
         runs = max(1, int(config.runs * runs_scale))
@@ -172,6 +184,10 @@ def run_sweep(
             if checkpoint_dir is not None:
                 checkpoint_dir.mkdir(parents=True, exist_ok=True)
                 kwargs["checkpoint_path"] = checkpoint_dir / f"{name}.npz"
+            if recorder is not None:
+                # The backend's batch/checkpoint spans share the sweep's
+                # run_id, so one ledger correlates the whole grid.
+                kwargs["telemetry"] = recorder
             res = get_backend("tpu")(config, **kwargs)
         else:
             res = get_backend(backend)(config)
@@ -199,8 +215,15 @@ def run_sweep(
                         bh.write(b"\n")
             with out_path.open("a") as fh:
                 fh.write(json.dumps(row) + "\n")
+        if recorder is not None:
+            recorder.emit(
+                "sweep_point", t_start=time.time() - row["elapsed_s"],
+                dur_s=row["elapsed_s"], point=name, runs=runs, backend=backend,
+            )
         if not quiet:
             print(f"[{name}] done in {row['elapsed_s']}s ({runs} runs)")
+    if recorder is not None:
+        recorder.close()
     return results
 
 
@@ -225,6 +248,11 @@ def main(argv: list[str] | None = None) -> int:
     )
     p.add_argument("--out", type=Path, help="append one JSON line per point here")
     p.add_argument("--checkpoint-dir", type=Path, help="per-point npz checkpoints (tpu backend)")
+    p.add_argument(
+        "--telemetry", type=Path, metavar="JSONL",
+        help="append one structured span ledger for the sweep here "
+        "(render with `python -m tpusim report`)",
+    )
     p.add_argument("--quiet", action="store_true")
     p.add_argument(
         "--no-probe", action="store_true",
@@ -276,6 +304,7 @@ def main(argv: list[str] | None = None) -> int:
         checkpoint_dir=args.checkpoint_dir,
         quiet=args.quiet,
         resume=args.resume,
+        telemetry_path=args.telemetry,
     )
     return 0
 

@@ -1,0 +1,210 @@
+"""Unified telemetry: run-scoped structured spans plus the shared metrics
+registry.
+
+The reference's only observability is a stdout progress percentage
+(main.cpp:219). Operating unattended production sweeps (ROADMAP north star)
+needs two correlated layers instead, and this module is the single host-side
+sink for both:
+
+  * **Structured spans** — every batch, sweep point, checkpoint save/load,
+    retry and pipelined-dispatch stall is one JSONL line
+    ``{"run_id", "span", "t_start", "dur_s", "attrs"}`` written by
+    :class:`TelemetryRecorder`. One ``run_id`` correlates every span of a
+    run (and every point of a sweep), so a ledger can be grepped, joined
+    across processes, or rendered into the ``tpusim report`` dashboard
+    (tpusim.report). ``t_start`` is wall-clock epoch seconds (cross-process
+    correlation); ``dur_s`` comes from the monotonic clock.
+  * **Metrics registry** — :class:`MetricsRegistry` accumulates per-batch
+    timing records and derives the phase/throughput report.
+    ``tpusim.profiling.Profiler`` is a thin client of it, and
+    :func:`throughput_report` is the one implementation of the steady-state
+    throughput math, shared by ``Profiler.report`` and the ``tpusim report``
+    dashboard — bench numbers and telemetry can never disagree about what
+    "steady-state sim-years/sec" means.
+
+Device-side counterpart: the engines accumulate per-run simulation counters
+(max reorg depth, stale-event count, active steps) in the carried aux tree at
+near-zero cost (tpusim.engine.SimCounters); the runner folds their per-batch
+reductions into each ``batch`` span's attrs, which is how sim-domain telemetry
+reaches this sink without an extra device round trip.
+
+Recorder writes are append-only, line-buffered, and crash-tolerant to read
+back: :func:`load_spans` skips truncated or foreign lines the same way the
+sweep ``--resume`` scanner does.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import time
+import uuid
+from pathlib import Path
+from typing import Any, Iterator
+
+__all__ = [
+    "TelemetryRecorder",
+    "MetricsRegistry",
+    "BatchRecord",
+    "throughput_report",
+    "load_spans",
+    "new_run_id",
+]
+
+
+def new_run_id() -> str:
+    """A fresh correlating id: short enough to grep, unique enough to join
+    telemetry from many hosts into one ledger."""
+    return uuid.uuid4().hex[:12]
+
+
+def _jsonable(value: Any) -> Any:
+    """Coerce numpy scalars/arrays (the usual attr payload from engine sums)
+    into plain JSON types; reject nothing — telemetry must never throw in the
+    hot loop, so unknown objects degrade to their repr."""
+    if isinstance(value, (str, int, float, bool)) or value is None:
+        return value
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    item = getattr(value, "item", None)
+    if callable(item):
+        try:
+            return _jsonable(item())
+        except (TypeError, ValueError):
+            pass
+    tolist = getattr(value, "tolist", None)
+    if callable(tolist):
+        try:
+            return _jsonable(tolist())
+        except (TypeError, ValueError):
+            pass
+    return repr(value)
+
+
+class TelemetryRecorder:
+    """Run-scoped JSONL span sink.
+
+    One recorder per logical run (or sweep); every emitted line carries its
+    ``run_id``. The file handle is opened lazily and line-buffered so a
+    killed process loses at most the line being written — which
+    :func:`load_spans` tolerates on read-back.
+    """
+
+    def __init__(self, path: str | Path, run_id: str | None = None):
+        self.path = Path(path)
+        self.run_id = run_id or new_run_id()
+        self._fh = None
+
+    def emit(
+        self,
+        span: str,
+        *,
+        t_start: float | None = None,
+        dur_s: float = 0.0,
+        **attrs: Any,
+    ) -> None:
+        """Append one span line. ``t_start`` defaults to now (an
+        instantaneous event); externally-timed spans pass their own."""
+        if self._fh is None:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            self._fh = self.path.open("a", buffering=1)
+        row = {
+            "run_id": self.run_id,
+            "span": span,
+            "t_start": round(time.time() if t_start is None else t_start, 6),
+            "dur_s": round(float(dur_s), 6),
+            "attrs": _jsonable(attrs),
+        }
+        self._fh.write(json.dumps(row) + "\n")
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs: Any) -> Iterator[dict[str, Any]]:
+        """Time a block as one span; the yielded dict lets the body add
+        result attrs before the line is written."""
+        t0_wall = time.time()
+        t0 = time.perf_counter()
+        extra: dict[str, Any] = dict(attrs)
+        try:
+            yield extra
+        finally:
+            self.emit(name, t_start=t0_wall, dur_s=time.perf_counter() - t0, **extra)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+def load_spans(path: str | Path) -> list[dict]:
+    """Read a telemetry JSONL back, skipping truncated/foreign lines (a
+    killed window can cut the final line mid-write, exactly like the sweep
+    output files — same tolerance policy as the ``--resume`` scanner)."""
+    spans = []
+    for line in Path(path).read_text().splitlines():
+        if not line.strip():
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(row, dict) and "span" in row:
+            spans.append(row)
+    return spans
+
+
+@dataclasses.dataclass
+class BatchRecord:
+    runs: int
+    elapsed_s: float
+
+
+def throughput_report(
+    records: list[BatchRecord], duration_ms: int, block_interval_s: float
+) -> dict[str, Any]:
+    """Phase timings + throughput from per-batch wall times — THE shared
+    derivation behind ``Profiler.report`` and the ``tpusim report``
+    dashboard. The first batch carries the jit compilation (compile + first
+    execution; JAX does not expose the split without a trace); steady-state
+    numbers use the remaining batches when there are any, and otherwise
+    reuse batch 0 with ``steady_is_first_batch=True`` — a single-batch run
+    has only compile-contaminated numbers and must say so instead of
+    passing them off as steady state."""
+    if not records:
+        return {"batches": 0}
+    total_runs = sum(r.runs for r in records)
+    total_s = sum(r.elapsed_s for r in records)
+    steady = records[1:] or records
+    steady_is_first_batch = not records[1:]
+    steady_runs = sum(r.runs for r in steady)
+    steady_s = sum(r.elapsed_s for r in steady) or 1e-12
+    years_per_run = duration_ms / (365.2425 * 86_400_000.0)
+    events_per_run = 2.0 * duration_ms / (block_interval_s * 1000.0)
+    return {
+        "batches": len(records),
+        "total_runs": total_runs,
+        "total_s": round(total_s, 4),
+        "first_batch_s": round(records[0].elapsed_s, 4),
+        "steady_is_first_batch": steady_is_first_batch,
+        "steady_runs_per_s": round(steady_runs / steady_s, 3),
+        "steady_sim_years_per_s": round(steady_runs * years_per_run / steady_s, 3),
+        "steady_events_per_s": round(steady_runs * events_per_run / steady_s, 1),
+    }
+
+
+@dataclasses.dataclass
+class MetricsRegistry:
+    """The shared sink for host-side batch timing. ``Profiler`` delegates
+    storage and report derivation here; anything else that times batches
+    (bench loops, ad-hoc harnesses) can feed the same registry and get the
+    same report."""
+
+    batches: list[BatchRecord] = dataclasses.field(default_factory=list)
+
+    def record_batch(self, runs: int, elapsed_s: float) -> None:
+        self.batches.append(BatchRecord(runs, elapsed_s))
+
+    def throughput(self, duration_ms: int, block_interval_s: float) -> dict[str, Any]:
+        return throughput_report(self.batches, duration_ms, block_interval_s)
