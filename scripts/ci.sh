@@ -6,6 +6,13 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+echo "== tpusim lint =="
+# Pure-AST static analysis (tpusim.lint): fails on any NEW finding — the
+# committed baseline grandfathers old ones. Runs first because it needs no
+# jax import and catches donated-buffer/host-sync/recompile mistakes in
+# seconds, before the expensive legs spin up.
+python -m tpusim.cli lint --baseline .tpusim-lint-baseline.json
+
 echo "== native: build + ASan/UBSan/TSan smoke =="
 make -C native check
 

@@ -30,7 +30,6 @@ otherwise record the measured write-up and stop.
 from __future__ import annotations
 
 import argparse
-import functools
 import json
 import sys
 import time

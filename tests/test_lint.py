@@ -1,0 +1,860 @@
+"""tpusim lint: every rule catches its seeded violation and passes the clean
+twin; suppression comments and the baseline round-trip behave; a fresh JX003
+use-after-donation introduced into the REAL engine.py source fails the gate
+(the CI-leg contract); and compile_count_guard pins one-compile-per-shape on
+Engine.run_batch (the runtime half of JX006).
+"""
+
+from __future__ import annotations
+
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tpusim.lint import Baseline, Finding, LintConfig, lint_source
+from tpusim.lint.cli import main as lint_main
+
+REPO = Path(__file__).resolve().parent.parent
+
+#: Fixture config: fixture paths double as the project's special module sets.
+CFG = LintConfig(
+    hot_modules=("hot.py",),
+    device_modules=("device.py",),
+    unused_globs=("scripts/*.py",),
+)
+
+
+def rules_of(findings: list[Finding]) -> set[str]:
+    return {f.rule for f in findings}
+
+
+def lint(src: str, path: str = "mod.py", rules=None) -> list[Finding]:
+    return lint_source(textwrap.dedent(src), path, config=CFG, rules=rules)
+
+
+# ---------------------------------------------------------------------------
+# JX001 — tracer branch.
+
+_JX001_BAD = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        if x > 0:
+            return x + 1
+        return x
+"""
+
+_JX001_CLEAN = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        return jnp.where(x > 0, x + 1, x)
+"""
+
+
+def test_jx001_seeded_and_clean():
+    assert rules_of(lint(_JX001_BAD)) == {"JX001"}
+    assert lint(_JX001_CLEAN) == []
+
+
+def test_jx001_static_annotations_and_shape_reads_are_exempt():
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x, exact: bool):
+            if exact:                  # static-by-convention Python bool
+                x = x * 2
+            if x.shape[0] > 4:         # shape metadata is static
+                x = x + 1
+            if x is not None:          # trace-time None check
+                x = x - 1
+            while x.ndim > 2:
+                x = x.sum(0)
+            return x
+    """
+    assert lint(src) == []
+
+
+def test_jx001_reaches_scan_bodies_transitively():
+    src = """
+        import jax
+
+        def outer(carry, xs):
+            return helper(carry, xs), None
+
+        def helper(c, x):
+            if c:                      # tracer: helper is scan-reachable
+                return c
+            return x
+
+        def run(init, xs):
+            return jax.lax.scan(outer, init, xs)
+    """
+    found = lint(src)
+    assert rules_of(found) == {"JX001"}
+    assert all("helper" in f.message for f in found)
+
+
+# ---------------------------------------------------------------------------
+# JX002 — implicit host sync in hot loops.
+
+_JX002_BAD = """
+    import numpy as np
+
+    class Driver:
+        def run(self, keys):
+            flags = []
+            for i in range(8):
+                state, flag = self._pipe_chunk(keys, i)
+                flags.append(flag)
+                if int(flags.pop(0)) == 0:
+                    break
+            for s in state:
+                rows = np.asarray(s)
+            return rows
+"""
+
+_JX002_CLEAN = """
+    import numpy as np
+
+    class Driver:
+        def run(self, keys):
+            flags = []
+            for i in range(8):
+                state, flag = self._pipe_chunk(keys, i)
+                flags.append(flag)
+            done = np.asarray(flags)  # ONE batch-end transfer, after the loop
+            return state, done
+"""
+
+
+def test_jx002_seeded_and_clean():
+    found = lint(_JX002_BAD, path="hot.py")
+    assert rules_of(found) == {"JX002"}
+    assert len(found) == 2  # the int() flag fetch and the in-loop asarray
+    # The batch-end transfer comprehension outside the dispatch loop is not
+    # a per-iteration sync — but comprehensions that ARE the loop still
+    # count, so the clean twin moves the fetch after the loop entirely.
+    assert lint(_JX002_CLEAN, path="hot.py") == []
+
+
+def test_jx002_only_applies_to_hot_modules():
+    assert lint(_JX002_BAD, path="cold.py") == []
+
+
+def test_jx002_block_until_ready_flagged_anywhere_in_hot_module():
+    src = """
+        def warmup(engine, keys):
+            out = engine.run_batch_async(keys)()
+            out.block_until_ready()
+    """
+    assert rules_of(lint(src, path="hot.py")) == {"JX002"}
+
+
+# ---------------------------------------------------------------------------
+# JX003 — use-after-donation.
+
+_JX003_BAD = """
+    import jax
+
+    step = jax.jit(_step_impl, donate_argnums=(0, 1))
+
+    def drive(state, aux, keys):
+        out_state, out_aux = step(state, aux)
+        return state.t, out_state       # `state` was donated above
+"""
+
+_JX003_CLEAN = """
+    import jax
+
+    step = jax.jit(_step_impl, donate_argnums=(0, 1))
+
+    def drive(state, aux, keys):
+        state, aux = step(state, aux)   # donated names rebound by the call
+        return state.t, aux
+"""
+
+
+def test_jx003_seeded_and_clean():
+    found = lint(_JX003_BAD)
+    assert rules_of(found) == {"JX003"}
+    assert "donated" in found[0].message and "state" in found[0].message
+    assert lint(_JX003_CLEAN) == []
+
+
+def test_jx003_partial_jit_decorator_form():
+    bad = """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(buf, x):
+            return buf + x
+
+        def drive(buf, x):
+            out = step(buf, x)
+            return buf, out             # `buf` was donated to step
+    """
+    clean = """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(buf, x):
+            return buf + x
+
+        def drive(buf, x):
+            buf = step(buf, x)
+            return buf
+    """
+    assert rules_of(lint(bad)) == {"JX003"}
+    assert lint(clean) == []
+
+
+def test_jx003_reads_in_opposite_if_arm_are_not_flagged():
+    clean = """
+        import jax
+
+        step = jax.jit(_impl, donate_argnums=(0,))
+
+        def drive(buf, keys, fast: bool):
+            if fast:
+                out = step(buf, keys)
+                return out
+            else:
+                return buf.copy()       # step never ran on this path
+    """
+    assert lint(clean) == []
+
+
+def test_jx003_multiline_call_args_and_nested_closures():
+    # A black-formatted multi-line donating call: its own argument reads on
+    # continuation lines are the donation itself, not a use-after.
+    clean = """
+        import jax
+
+        step = jax.jit(_impl, donate_argnums=(0,))
+
+        def drive(state, keys):
+            out = step(
+                state,
+                keys,
+            )
+            return out
+    """
+    assert lint(clean) == []
+    # A same-named local in a nested closure is a different binding and must
+    # not mask the real use-after-donation in the outer scope.
+    bad = """
+        import jax
+
+        step = jax.jit(_impl, donate_argnums=(0,))
+
+        def drive(state, keys):
+            out = step(state, keys)
+
+            def helper():
+                state = make()
+                return state
+
+            return state.t, out, helper
+    """
+    assert rules_of(lint(bad)) == {"JX003"}
+
+
+def test_module_scope_is_scanned():
+    # JX002 at script top level (hot module): the exact host-sync pattern,
+    # just not wrapped in a def.
+    bad = """
+        import numpy as np
+
+        flags = []
+        for i in range(8):
+            state, flag = engine._pipe_chunk(keys, i)
+            flags.append(flag)
+            done = int(flags.pop(0))
+    """
+    assert rules_of(lint(bad, path="hot.py")) == {"JX002"}
+    # JX004 at module scope.
+    bad_key = """
+        import jax
+
+        a = jax.random.normal(key, (4,))
+        b = jax.random.uniform(key, (4,))
+    """
+    assert rules_of(lint(bad_key)) == {"JX004"}
+
+
+def test_suppression_covers_multiline_statement():
+    src = """
+        import jax
+
+        @jax.jit
+        def step(x, lo):
+            # tpusim-lint: disable=JX001 -- covers the whole statement below
+            if (
+                x > lo
+            ):
+                return x + 1
+            return x
+    """
+    assert lint(src) == []
+
+
+def test_jx003_attribute_assigned_jit_with_int_donate():
+    src = """
+        import jax
+
+        class Eng:
+            def __init__(self):
+                self._go = jax.jit(self._impl, donate_argnums=0)
+
+            def run(self, buf, keys):
+                out = self._go(buf, keys)
+                return buf + out
+    """
+    found = lint(src)
+    assert rules_of(found) == {"JX003"}
+
+
+# ---------------------------------------------------------------------------
+# JX004 — PRNG state reuse.
+
+_JX004_BAD = """
+    import jax
+
+    def draw(key):
+        a = jax.random.normal(key, (4,))
+        b = jax.random.uniform(key, (4,))
+        return a, b
+"""
+
+_JX004_CLEAN = """
+    import jax
+
+    def draw(key):
+        k1, k2 = jax.random.split(key)
+        a = jax.random.normal(k1, (4,))
+        b = jax.random.uniform(k2, (4,))
+        return a, b
+"""
+
+
+def test_jx004_seeded_and_clean():
+    found = lint(_JX004_BAD)
+    assert rules_of(found) == {"JX004"}
+    assert lint(_JX004_CLEAN) == []
+
+
+def test_jx004_loop_reuse_and_per_iteration_split():
+    bad = """
+        import jax
+
+        def draw(key, n):
+            out = []
+            for i in range(n):
+                out.append(jax.random.bits(key, (2,)))
+            return out
+    """
+    clean = """
+        import jax
+
+        def draw(key, n):
+            out = []
+            for i in range(n):
+                key, sub = jax.random.split(key)
+                out.append(jax.random.bits(sub, (2,)))
+            return out
+    """
+    assert rules_of(lint(bad)) == {"JX004"}
+    assert lint(clean) == []
+
+
+def test_jx004_if_else_arms_are_not_reuse():
+    clean = """
+        import jax
+
+        def draw(key, cond: bool):
+            if cond:
+                return jax.random.uniform(key, (4,))
+            else:
+                return jax.random.normal(key, (4,))
+    """
+    assert lint(clean) == []
+    # ...but a consumption AFTER the if/else still conflicts with both arms.
+    bad = """
+        import jax
+
+        def draw(key, cond: bool):
+            if cond:
+                a = jax.random.uniform(key, (4,))
+            else:
+                a = jax.random.normal(key, (4,))
+            return a + jax.random.bits(key, (4,))
+    """
+    assert rules_of(lint(bad)) == {"JX004"}
+
+
+def test_jx004_sibling_nested_functions_do_not_conflate():
+    clean = """
+        import jax
+
+        def make(key):
+            def one():
+                return jax.random.uniform(key, (2,))
+
+            def two(key):
+                return jax.random.normal(key, (2,))
+
+            return one, two
+    """
+    assert lint(clean) == []
+
+
+def test_jx004_xoroshiro_consumer_from_config():
+    bad = """
+        def step(xi):
+            s1, hi, lo = next_words(xi)
+            s2, h2, l2 = next_words(xi)   # same stream consumed twice
+            return hi, h2
+    """
+    clean = """
+        def step(xi):
+            xi, hi, lo = next_words(xi)
+            xi, h2, l2 = next_words(xi)
+            return hi, h2
+    """
+    assert rules_of(lint(bad)) == {"JX004"}
+    assert lint(clean) == []
+
+
+# ---------------------------------------------------------------------------
+# JX005 — dtype drift.
+
+_JX005_BAD = """
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+
+    @jax.jit
+    def scale(x):
+        return x * np.float64(2.0)
+"""
+
+_JX005_CLEAN = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def scale(x):
+        return x * jnp.float32(2.0)
+"""
+
+
+def test_jx005_seeded_and_clean():
+    found = lint(_JX005_BAD)
+    assert rules_of(found) == {"JX005"}
+    assert lint(_JX005_CLEAN) == []
+
+
+def test_jx005_builtin_dtype_and_bare_float_literal():
+    bad = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def make(x):
+            a = jnp.zeros(4, dtype=float)
+            b = jnp.asarray(0.5)
+            return a, b, x
+    """
+    clean = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def make(x):
+            a = jnp.zeros(4, dtype=jnp.float32)
+            b = jnp.asarray(0.5, jnp.float32)
+            return a, b, x
+    """
+    found = lint(bad)
+    assert rules_of(found) == {"JX005"} and len(found) == 2
+    assert lint(clean) == []
+
+
+# ---------------------------------------------------------------------------
+# JX006 — recompilation risk.
+
+_JX006_BAD = """
+    import jax
+
+    chunk = jax.jit(_chunk_impl)
+
+    def run(state, n):
+        for i in range(n):
+            state = chunk(state, i)
+        return state
+"""
+
+_JX006_CLEAN = """
+    import jax
+    import jax.numpy as jnp
+
+    chunk = jax.jit(_chunk_impl)
+
+    def run(state, n):
+        for i in range(n):
+            state = chunk(state, jnp.asarray(i, jnp.uint32))
+        return state
+"""
+
+
+def test_jx006_seeded_and_clean():
+    found = lint(_JX006_BAD)
+    assert rules_of(found) == {"JX006"}
+    assert "loop variable" in found[0].message
+    assert lint(_JX006_CLEAN) == []
+
+
+def test_jx006_bare_jit_decorator_is_registered():
+    bad = """
+        import jax
+
+        @jax.jit
+        def step(state, i):
+            return state
+
+        def run(state, n):
+            for i in range(n):
+                state = step(state, i)
+            return state
+    """
+    assert rules_of(lint(bad)) == {"JX006"}
+
+
+def test_jx003_next_iteration_read_of_donated_buffer_in_loop():
+    bad = """
+        import jax
+
+        step = jax.jit(_impl, donate_argnums=(0,))
+
+        def drive(state, n):
+            for i in range(n):
+                probe = state.sum()      # iteration 2 reads a donated buffer
+                out = step(state, probe)
+            return out
+    """
+    clean = """
+        import jax
+
+        step = jax.jit(_impl, donate_argnums=(0,))
+
+        def drive(state, n):
+            for i in range(n):
+                probe = state.sum()
+                state = step(state, probe)   # rebound every iteration
+            return state
+    """
+    found = lint(bad)
+    assert rules_of(found) == {"JX003"}
+    assert any("next iteration" in f.message for f in found)
+    assert lint(clean) == []
+
+
+def test_jx006_scalar_literal_in_loop():
+    bad = """
+        import jax
+
+        step = jax.jit(_impl)
+
+        def run(state):
+            while state is not None:
+                state = step(state, 0.5)
+            return state
+    """
+    assert rules_of(lint(bad)) == {"JX006"}
+
+
+# ---------------------------------------------------------------------------
+# JX007 — nondeterministic host calls in device modules.
+
+_JX007_BAD = """
+    import time
+
+    def step(state):
+        t0 = time.perf_counter()
+        return state, t0
+"""
+
+_JX007_CLEAN = """
+    def step(state, now):
+        return state, now
+"""
+
+
+def test_jx007_seeded_and_clean():
+    found = lint(_JX007_BAD, path="device.py")
+    assert rules_of(found) == {"JX007"}
+    assert lint(_JX007_CLEAN, path="device.py") == []
+    # Host orchestration modules may use time freely.
+    assert lint(_JX007_BAD, path="runner_like.py") == []
+
+
+# ---------------------------------------------------------------------------
+# JX008 — unused reachability (scripts only).
+
+_JX008_BAD = """
+    import json
+    import os
+
+    def helper(x):
+        return x + 1
+
+    def main():
+        return json.dumps({})
+"""
+
+_JX008_CLEAN = """
+    import json
+
+    def helper(x):
+        return x + 1
+
+    def main():
+        return json.dumps(helper(1))
+"""
+
+
+def test_jx008_seeded_and_clean():
+    found = lint(_JX008_BAD, path="scripts/tool.py")
+    assert rules_of(found) == {"JX008"}
+    assert len(found) == 2  # `os` import and `helper`
+    assert lint(_JX008_CLEAN, path="scripts/tool.py") == []
+    # Package modules are out of scope: public API is invisible reachability.
+    assert lint(_JX008_BAD, path="mod.py") == []
+
+
+# ---------------------------------------------------------------------------
+# Suppressions.
+
+
+def test_suppression_same_line_and_line_above():
+    same_line = """
+        import jax
+
+        @jax.jit
+        def step(x):
+            if x > 0:  # tpusim-lint: disable=JX001 -- trace-time constant here
+                return x + 1
+            return x
+    """
+    assert lint(same_line) == []
+    above = """
+        import jax
+
+        @jax.jit
+        def step(x):
+            # tpusim-lint: disable=JX001 -- reason strings may wrap over
+            # several comment lines before the code they cover.
+            if x > 0:
+                return x + 1
+            return x
+    """
+    assert lint(above) == []
+
+
+def test_suppression_is_rule_specific():
+    src = """
+        import jax
+
+        @jax.jit
+        def step(x):
+            if x > 0:  # tpusim-lint: disable=JX005 -- wrong rule id
+                return x + 1
+            return x
+    """
+    assert rules_of(lint(src)) == {"JX001"}
+
+
+# ---------------------------------------------------------------------------
+# Baseline round-trip + the CI gate contract.
+
+
+def test_baseline_round_trip(tmp_path):
+    findings = lint(_JX001_BAD)
+    assert findings
+    path = tmp_path / "baseline.json"
+    Baseline.write(path, findings)
+    bl = Baseline.load(path)
+    new, old = bl.split(findings)
+    assert new == [] and len(old) == len(findings)
+    # A fresh violation in another file is NOT grandfathered.
+    fresh = lint(_JX004_BAD, path="other.py")
+    new, old = bl.split(findings + fresh)
+    assert {f.rule for f in new} == {"JX004"} and len(old) == len(findings)
+
+
+def test_baseline_survives_line_shift(tmp_path):
+    findings = lint(_JX001_BAD)
+    path = tmp_path / "baseline.json"
+    Baseline.write(path, findings)
+    shifted = lint("\n# a new comment line\n\n" + textwrap.dedent(_JX001_BAD))
+    new, _ = Baseline.load(path).split(shifted)
+    assert new == []
+
+
+def test_committed_baseline_gate_is_green():
+    """The acceptance invariant: `tpusim lint --baseline ...` exits 0 on the
+    repo as committed."""
+    rc = lint_main(["--baseline", str(REPO / ".tpusim-lint-baseline.json"), "--quiet"])
+    assert rc == 0
+
+
+def test_fresh_jx003_in_engine_fails_the_gate():
+    """Simulates the CI contract end-to-end on the REAL engine source: a
+    use-after-donation freshly introduced into engine.py must produce a new
+    (non-baselined) JX003 finding, i.e. fail the lint leg."""
+    src = (REPO / "tpusim" / "engine.py").read_text()
+    src += textwrap.dedent("""
+
+        def _bad_drive(engine, state, aux, hi, lo, keys, params):
+            engine._pipe_chunk(state, aux, hi, lo, keys, 0, params)
+            return state, hi
+    """)
+    from tpusim.lint import load_config
+
+    findings = lint_source(src, "tpusim/engine.py", config=load_config())
+    jx003 = [f for f in findings if f.rule == "JX003"]
+    assert jx003, "seeded use-after-donation not caught"
+    assert {"state", "hi"} <= {f.message.split("`")[1] for f in jx003}
+    bl = Baseline.load(REPO / ".tpusim-lint-baseline.json")
+    new, _ = bl.split(findings)
+    assert any(f.rule == "JX003" for f in new)
+
+
+def test_cli_rules_filter_and_list(tmp_path, capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in ("JX001", "JX007", "JX008"):
+        assert rule_id in out
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n\n@jax.jit\ndef f(x):\n    if x > 0:\n        return x\n    return -x\n")
+    # Path outside the repo root: lint it via the paths argument.
+    rc = lint_main([str(bad), "--rules", "JX004", "--quiet"])
+    assert rc == 0  # JX001 not in the requested rule set
+    rc = lint_main([str(bad), "--rules", "JX001", "--quiet"])
+    assert rc == 1
+    assert lint_main([str(bad), "--rules", "JX999"]) == 2
+
+
+def test_cli_directory_args_respect_config_and_dedupe(tmp_path, capsys):
+    """`lint tpusim` must agree with the bare CI invocation's file set (the
+    config-excluded lint package stays out), and repeating a path must not
+    duplicate findings."""
+    import argparse
+
+    from tpusim.lint.cli import _collect_files, _repo_root
+    from tpusim.lint import load_config
+
+    root = _repo_root()
+    cfg = load_config(root / "pyproject.toml")
+    by_dir = _collect_files(
+        argparse.Namespace(paths=[Path("tpusim")]), root, cfg
+    )
+    assert by_dir, "directory expansion found nothing"
+    assert not any("lint" in f.parts[-2] for f in by_dir)
+    doubled = _collect_files(
+        argparse.Namespace(paths=[Path("tpusim"), Path("tpusim")]), root, cfg
+    )
+    assert doubled == by_dir
+    # An explicitly named single file is linted even if config-excluded.
+    direct = _collect_files(
+        argparse.Namespace(paths=[Path("tpusim/lint/rules.py")]), root, cfg
+    )
+    assert len(direct) == 1
+
+
+def test_repo_root_follows_cwd(tmp_path, monkeypatch):
+    """An installed tpusim must lint the project it is run IN: the root is
+    the nearest CWD ancestor with a pyproject.toml, so a checkout-less CWD
+    falls back to the package checkout instead of silently linting 0 files."""
+    from tpusim.lint.cli import _repo_root
+
+    proj = tmp_path / "proj" / "sub"
+    proj.mkdir(parents=True)
+    (tmp_path / "proj" / "pyproject.toml").write_text("[tool.tpusim-lint]\n")
+    monkeypatch.chdir(proj)
+    assert _repo_root() == (tmp_path / "proj").resolve()
+    monkeypatch.chdir(REPO)
+    assert _repo_root() == REPO
+
+
+def test_cli_subcommand_dispatch(capsys):
+    from tpusim.cli import main as tpusim_main
+
+    assert tpusim_main(["lint", "--list-rules"]) == 0
+    assert "JX001" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# compile_count_guard: the runtime complement of JX006.
+
+
+def test_compile_count_guard_counts_and_asserts():
+    import jax
+    import jax.numpy as jnp
+
+    from tpusim.testing import compile_count_guard
+
+    f = jax.jit(lambda x: x * 3 + 1)
+    shape_probe = jnp.ones(4)  # compile jnp.ones outside the guarded block
+    with compile_count_guard() as cold:
+        f(shape_probe).block_until_ready()
+    assert cold.count >= 1
+    with compile_count_guard(exact=0):
+        f(jnp.ones(4))
+    with pytest.raises(AssertionError, match="expected exactly 0"):
+        with compile_count_guard(exact=0):
+            f(jnp.ones(16))  # new shape: must recompile
+
+
+def test_run_batch_compiles_once_per_shape():
+    """The enforced JX006 invariant on the headline path: after one warm-up
+    batch, further same-shape batches of Engine.run_batch must not trigger a
+    single XLA compilation — the device-loop program is compiled exactly once
+    per (batch shape, config) and reused for every subsequent batch."""
+    from tpusim.config import SimConfig, default_network
+    from tpusim.engine import Engine
+    from tpusim.runner import make_run_keys
+    from tpusim.testing import compile_count_guard
+
+    config = SimConfig(
+        network=default_network(propagation_ms=1000),
+        duration_ms=4 * 86_400_000,
+        runs=8,
+        batch_size=8,
+        seed=11,
+    )
+    engine = Engine(config)
+    # Keys are *inputs* to run_batch and are built outside the guard: arange
+    # with a nonzero start traces a different (tiny) program than arange(0, n),
+    # which is key-construction cost, not an engine recompile.
+    keys = [make_run_keys(11, start, 8) for start in (0, 8, 16, 24)]
+    warm = engine.run_batch(keys[0])
+    with compile_count_guard(exact=0):
+        out = engine.run_batch(keys[1])
+    assert out["runs"] == 8
+    assert warm["blocks_found_sum"].shape == out["blocks_found_sum"].shape
+    # The pipelined dispatch path compiles its own (donating) chunk executable
+    # on first use, but a SECOND pipelined batch must be compile-free too.
+    engine.run_batch(keys[2], pipelined=True)
+    with compile_count_guard(exact=0):
+        engine.run_batch(keys[3], pipelined=True)

@@ -19,7 +19,6 @@ ledger — or a ``--trace-dir`` XLA trace directory — into a dashboard.
 from __future__ import annotations
 
 import argparse
-import json
 import sys
 from pathlib import Path
 
@@ -164,6 +163,12 @@ def main(argv: list[str] | None = None) -> int:
         from .report import main as report_main
 
         return report_main(argv[1:])
+    if argv and argv[0] == "lint":
+        # Same dispatch rule as "report". Imports nothing heavy: the linter
+        # is pure-AST and must run (fast) in CI before any jax import.
+        from .lint.cli import main as lint_main
+
+        return lint_main(argv[1:])
     args = build_parser().parse_args(argv)
     try:
         config = config_from_args(args)

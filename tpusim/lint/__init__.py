@@ -1,0 +1,53 @@
+"""``tpusim lint`` — a project-aware static analyzer for the JAX hygiene
+invariants this codebase's three dispatch paths (scan, pallas, pipelined)
+depend on but no runtime test can see until they break on hardware.
+
+The failure modes are the ones the TPU Monte-Carlo literature (Ising-on-TPU,
+tfp.mcmc on TPU — PAPERS.md) and this repo's own PR history keep rediscovering:
+host syncs hidden in hot loops, donated buffers read after the donating call,
+tracer-typed Python branches that silently retrace, dtype drift under the x64
+compat shim, and recompilation inside dispatch loops. Each is an AST-visible
+pattern; catching them at review time is the cheapest correctness tooling we
+can add ahead of a TPU-tunnel session.
+
+Rules (see :mod:`tpusim.lint.rules` for the precise semantics):
+
+  JX001  Python ``if``/``while`` on tracer-typed values in jit-reachable code
+  JX002  implicit host sync (``.item()``, ``int()``, ``np.asarray``, ...)
+         inside engine/runner hot loops
+  JX003  use-after-donation: a name passed at a ``donate_argnums`` position
+         of a jitted callable and read afterwards
+  JX004  PRNG state reuse: one key consumed twice without split/fold_in
+  JX005  dtype drift: ``np.float64``/``np.int64``/builtin dtypes entering
+         jitted math under the ``compat.enable_x64`` shim
+  JX006  recompilation risk: jitted callables invoked with Python scalars
+         or loop variables inside loops
+  JX007  nondeterministic host calls (``time``, ``random``) in device-math
+         modules
+  JX008  unused-reachability: module-level defs/imports nothing references
+         (scripts only by default), so shims cannot accrete dead helpers
+
+Suppression: append ``# tpusim-lint: disable=JX002 -- reason`` to the
+offending line (or put the comment alone on the line above). A committed
+baseline file grandfathers pre-existing findings; the CI gate fails only on
+*new* ones. Configuration lives in ``[tool.tpusim-lint]`` in pyproject.toml.
+"""
+
+from __future__ import annotations
+
+from .analysis import ModuleAnalysis
+from .baseline import Baseline
+from .config import LintConfig, load_config
+from .findings import Finding
+from .rules import ALL_RULES, lint_paths, lint_source
+
+__all__ = [
+    "ALL_RULES",
+    "Baseline",
+    "Finding",
+    "LintConfig",
+    "ModuleAnalysis",
+    "lint_paths",
+    "lint_source",
+    "load_config",
+]

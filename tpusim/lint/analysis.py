@@ -1,0 +1,595 @@
+"""Per-module AST analysis shared by every rule.
+
+One :class:`ModuleAnalysis` is built per file and answers the project-aware
+questions the rules need:
+
+  * which function defs are **jit-reachable** — passed to ``jax.jit`` /
+    ``lax.scan`` / ``vmap`` / ``shard_map`` / ``pallas_call`` (directly or as
+    a decorator), or called — transitively, within the module — from one
+    that is;
+  * which assigned names are **jitted callables** (``f = jax.jit(g, ...)``),
+    with their ``donate_argnums`` positions;
+  * which local names hold **tracer values** inside a jit-reachable function
+    (annotation-aware taint: parameters annotated with Python scalar types
+    are static by this codebase's convention, and ``.shape``/``.dtype``
+    reads or host casts assigned to a FRESH name stay static — the taint
+    set is a monotone fixpoint over names, so rebinding the *same* name,
+    ``x = int(x)``, conservatively keeps ``x`` tainted);
+  * which names hold **device values** in host orchestration code (taint
+    seeded by ``jnp.*``/``jax.*`` calls and the engine's jitted entry
+    points, propagated through containers — the pipelined dispatch path
+    hands device flags around in a deque).
+
+The analysis is deliberately per-module and name-based: no imports are
+resolved, no types inferred. That keeps it fast, dependency-free and
+predictable — cross-module reachability is the configured module lists'
+job (``hot_modules``, ``device_modules``), not a whole-program analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .config import LintConfig
+from .findings import Suppressions
+
+#: Call targets (final dotted component) that make a function argument
+#: jit-reachable: its body runs under trace.
+_JIT_ENTRY_CALLS = frozenset({
+    "jit", "scan", "while_loop", "fori_loop", "cond", "switch", "vmap",
+    "pmap", "grad", "value_and_grad", "shard_map", "pallas_call", "checkpoint",
+    "remat", "associative_scan", "map",
+})
+
+#: Annotations naming static-by-convention Python scalars: a parameter so
+#: annotated is a trace-time constant, not a tracer.
+_STATIC_ANNOTATIONS = frozenset({"bool", "int", "float", "str", "bytes"})
+
+#: Attribute reads that return static metadata even off a tracer.
+_STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "sharding"})
+
+#: Builtin calls whose result is a concrete host value (JX002 owns whether
+#: the *cast itself* was legal; for taint purposes the result is static).
+_HOST_CASTS = frozenset({"int", "float", "bool", "len", "isinstance", "str", "repr"})
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``jax.random.bits`` / ``self._pipe_chunk`` / ``np`` — or None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def assigned_names(target: ast.AST) -> list[str]:
+    """Flat Name targets of an assignment target (tuples unpacked)."""
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: list[str] = []
+        for elt in target.elts:
+            out.extend(assigned_names(elt))
+        return out
+    if isinstance(target, ast.Starred):
+        return assigned_names(target.value)
+    return []
+
+
+@dataclass
+class FuncInfo:
+    node: ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda
+    name: str
+    qualname: str
+    jit_entered: bool = False
+    #: simple callee names (Name or self.<attr>) this function's body calls.
+    callees: set[str] = field(default_factory=set)
+
+
+@dataclass
+class JittedCallable:
+    key: str  # bare name or attribute name ("_pipe_chunk")
+    line: int
+    donate: tuple[int, ...] = ()
+
+
+class ModuleAnalysis:
+    def __init__(self, path: str, source: str, config: LintConfig):
+        self.path = path
+        self.source = source
+        self.config = config
+        self.tree = ast.parse(source)
+        self.lines = source.splitlines()
+        self.suppressions = Suppressions(source)
+        self.suppressions.extend_spans(self.tree)
+        self.funcs: list[FuncInfo] = []
+        self._func_by_name: dict[str, list[FuncInfo]] = {}
+        self.jitted: dict[str, JittedCallable] = {}
+        self._parents: dict[ast.AST, ast.AST] = {}
+        self._collect()
+
+    # -- structure ----------------------------------------------------------
+
+    def _collect(self) -> None:
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+        # Function defs with qualified names.
+        def visit(node: ast.AST, prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qn = f"{prefix}{child.name}"
+                    info = FuncInfo(child, child.name, qn)
+                    self.funcs.append(info)
+                    self._func_by_name.setdefault(child.name, []).append(info)
+                    visit(child, qn + ".")
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, f"{prefix}{child.name}.")
+                else:
+                    visit(child, prefix)
+        visit(self.tree, "")
+        for info in self.funcs:
+            info.callees = self._callee_names(info.node)
+        self._find_jit_entries()
+        self._find_jitted_callables()
+
+    def _callee_names(self, func: ast.AST) -> set[str]:
+        out: set[str] = set()
+        for node in self._walk_own(func):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name is None:
+                    continue
+                if name.startswith("self."):
+                    out.add(name.split(".", 1)[1])
+                elif "." not in name:
+                    out.add(name)
+        return out
+
+    def _walk_own(self, func: ast.AST):
+        """Walk a function's body including nested defs (closures share the
+        trace context) — the caller decides whether that matters."""
+        yield from ast.walk(func)
+
+    def enclosing_function(self, node: ast.AST) -> ast.AST | None:
+        cur = self._parents.get(node)
+        while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            cur = self._parents.get(cur)
+        return cur
+
+    def inside_loop(self, node: ast.AST, *, comprehensions: bool = True) -> bool:
+        """Is ``node`` lexically inside a For/While (or comprehension) body,
+        without crossing a function-def boundary (a nested def's body is its
+        own execution context, entered per call, not per iteration)?"""
+        cur = self._parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                return True
+            if comprehensions and isinstance(
+                cur, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+            ):
+                return True
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False
+            cur = self._parents.get(cur)
+        return False
+
+    def own_nodes(self, func: ast.AST):
+        """Walk a function's body WITHOUT descending into nested function
+        defs — each nested def has its own FuncInfo and is analyzed in its
+        own scope (a same-named local in a sibling closure is a different
+        binding, not a reuse)."""
+        stack = list(ast.iter_child_nodes(func))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                stack.extend(ast.iter_child_nodes(node))
+
+    def branch_arms(self, node: ast.AST, stop: ast.AST) -> list[tuple[int, bool]]:
+        """The (If-statement id, in-else-arm) chain from ``node`` up to
+        ``stop``: two nodes are mutually exclusive when some shared If places
+        them in different arms — an if/else that consumes the same key once
+        per path is NOT a reuse."""
+        arms: list[tuple[int, bool]] = []
+        child, cur = node, self._parents.get(node)
+        while cur is not None and cur is not stop:
+            if isinstance(cur, ast.If):
+                # ``child`` is the If's immediate child on the parent chain:
+                # one of test / body stmts / orelse stmts.
+                arms.append((id(cur), any(child is n for n in cur.orelse)))
+            child, cur = cur, self._parents.get(cur)
+        return arms
+
+    def mutually_exclusive(self, a: ast.AST, b: ast.AST, scope: ast.AST) -> bool:
+        arms_a = dict(self.branch_arms(a, scope))
+        return any(
+            if_id in arms_a and arms_a[if_id] != in_else
+            for if_id, in_else in self.branch_arms(b, scope)
+        )
+
+    def enclosing_loop(self, node: ast.AST) -> ast.AST | None:
+        """Nearest enclosing For/While statement within the same function."""
+        cur = self._parents.get(node)
+        while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                return cur
+            cur = self._parents.get(cur)
+        return None
+
+    def loop_targets(self, node: ast.AST) -> set[str]:
+        """Names bound by For-loop targets enclosing ``node`` (same function)."""
+        out: set[str] = set()
+        cur = self._parents.get(node)
+        while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            if isinstance(cur, (ast.For, ast.AsyncFor)):
+                out.update(assigned_names(cur.target))
+            cur = self._parents.get(cur)
+        return out
+
+    # -- jit reachability ---------------------------------------------------
+
+    def _mark_entry(self, name: str) -> None:
+        for info in self._func_by_name.get(name, []):
+            info.jit_entered = True
+
+    def _find_jit_entries(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                callee = dotted_name(node.func)
+                if callee and callee.rsplit(".", 1)[-1] in _JIT_ENTRY_CALLS:
+                    for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                        ref = dotted_name(arg)
+                        if ref is None:
+                            continue
+                        self._mark_entry(ref.rsplit(".", 1)[-1])
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    names: list[str] = []
+                    d = dotted_name(dec)
+                    if d:
+                        names.append(d)
+                    if isinstance(dec, ast.Call):
+                        d = dotted_name(dec.func)
+                        if d:
+                            names.append(d)
+                        for a in dec.args:  # partial(jax.jit, ...)
+                            d = dotted_name(a)
+                            if d:
+                                names.append(d)
+                    if any(n.rsplit(".", 1)[-1] in _JIT_ENTRY_CALLS for n in names):
+                        self._mark_entry(node.name)
+        # Transitive closure over the in-module call graph.
+        changed = True
+        while changed:
+            changed = False
+            entered = {f.name for f in self.funcs if f.jit_entered}
+            for info in self.funcs:
+                if info.jit_entered:
+                    for callee in info.callees:
+                        if callee not in entered:
+                            self._mark_entry(callee)
+                            if any(
+                                f.jit_entered
+                                for f in self._func_by_name.get(callee, [])
+                            ):
+                                changed = True
+
+    def jit_entered_functions(self) -> list[FuncInfo]:
+        return [f for f in self.funcs if f.jit_entered]
+
+    # -- jitted-callable registry -------------------------------------------
+
+    @staticmethod
+    def _donate_positions(call: ast.Call) -> tuple[int, ...]:
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                v = kw.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    return (v.value,)
+                if isinstance(v, (ast.Tuple, ast.List)):
+                    return tuple(
+                        e.value
+                        for e in v.elts
+                        if isinstance(e, ast.Constant) and isinstance(e.value, int)
+                    )
+        return ()
+
+    @staticmethod
+    def _as_jit_call(call: ast.Call) -> ast.Call | None:
+        """The Call carrying jit's keywords, if this expression is one:
+        ``jax.jit(...)`` itself, or ``partial(jax.jit, donate_argnums=...)``
+        — whose keywords jit receives verbatim on application, so
+        ``donate_argnums`` sits on the partial call."""
+        fn = dotted_name(call.func)
+        leaf = fn.rsplit(".", 1)[-1] if fn else None
+        if leaf == "jit":
+            return call
+        if leaf == "partial" and any(
+            (dotted_name(a) or "").rsplit(".", 1)[-1] == "jit" for a in call.args
+        ):
+            return call
+        return None
+
+    def _find_jitted_callables(self) -> None:
+        for node in ast.walk(self.tree):
+            call: ast.Call | None = None
+            keys: list[str] = []
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                call = self._as_jit_call(node.value)
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        keys.append(tgt.id)
+                    elif isinstance(tgt, ast.Attribute):
+                        keys.append(tgt.attr)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        jc = self._as_jit_call(dec)
+                        if jc is not None:
+                            call, keys = jc, [node.name]
+                    elif (dotted_name(dec) or "").rsplit(".", 1)[-1] == "jit":
+                        # Bare ``@jax.jit``: jitted with no donate_argnums —
+                        # still a JX006 target.
+                        self.jitted[node.name] = JittedCallable(
+                            node.name, dec.lineno, ()
+                        )
+            if call is None:
+                continue
+            donate = self._donate_positions(call)
+            for key in keys:
+                self.jitted[key] = JittedCallable(key, call.lineno, donate)
+
+    def resolve_jitted(self, func_expr: ast.AST) -> JittedCallable | None:
+        """The registry entry a call target refers to (bare name or final
+        attribute name), if any."""
+        name = dotted_name(func_expr)
+        if name is None:
+            return None
+        return self.jitted.get(name.rsplit(".", 1)[-1])
+
+    # -- taint --------------------------------------------------------------
+
+    def tracer_tainted_names(self, info: FuncInfo) -> set[str]:
+        """Names holding tracer values inside a jit-reachable function:
+        parameters (minus self/cls and static-annotated scalars) plus
+        everything assigned from jax/jnp math or tainted operands."""
+        node = info.node
+        tainted: set[str] = set()
+        args = node.args
+        all_params = (
+            list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        )
+        for a in all_params:
+            if a.arg in ("self", "cls"):
+                continue
+            ann = a.annotation
+            ann_name = None
+            if isinstance(ann, ast.Name):
+                ann_name = ann.id
+            elif isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+                ann_name = ann.value.split("|")[0].strip()
+            if ann_name in _STATIC_ANNOTATIONS:
+                continue
+            tainted.add(a.arg)
+        if args.vararg:
+            tainted.add(args.vararg.arg)
+        if args.kwarg:
+            tainted.add(args.kwarg.arg)
+
+        def expr_tainted(e: ast.AST) -> bool:
+            return _expr_tainted(e, tainted)
+
+        changed = True
+        while changed:
+            changed = False
+            for sub in ast.walk(node):
+                new_names: list[str] = []
+                if isinstance(sub, ast.Assign):
+                    if expr_tainted(sub.value):
+                        new_names = [
+                            n for t in sub.targets for n in assigned_names(t)
+                        ]
+                elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                    if sub.value is not None and expr_tainted(sub.value):
+                        new_names = assigned_names(sub.target)
+                elif isinstance(sub, ast.NamedExpr):
+                    if expr_tainted(sub.value):
+                        new_names = assigned_names(sub.target)
+                elif isinstance(sub, (ast.For, ast.AsyncFor)):
+                    new_names = _for_target_taint(sub.target, sub.iter, expr_tainted)
+                for name in new_names:
+                    if name not in tainted:
+                        tainted.add(name)
+                        changed = True
+        return tainted
+
+    def device_tainted_names(self, func: ast.AST) -> set[str]:
+        """Names holding device values in host code: seeded by jnp/jax call
+        results and the configured engine entry points, propagated through
+        assignment, arithmetic and container append/pop."""
+        patterns = set(self.config.device_call_patterns)
+        tainted: set[str] = set()
+
+        def seeds_device(call: ast.Call) -> bool:
+            name = dotted_name(call.func)
+            if name is None:
+                return False
+            root, leaf = name.split(".", 1)[0], name.rsplit(".", 1)[-1]
+            if root in ("jnp", "jax") and leaf not in _HOST_CASTS:
+                return True
+            return leaf in patterns
+
+        def expr_tainted(e: ast.AST) -> bool:
+            return structural_taint(e, tainted, seed_call=seeds_device)
+
+        changed = True
+        while changed:
+            changed = False
+            for sub in ast.walk(func):
+                new: list[str] = []
+                if isinstance(sub, ast.Assign) and expr_tainted(sub.value):
+                    new = [n for t in sub.targets for n in assigned_names(t)]
+                elif (
+                    isinstance(sub, (ast.AugAssign, ast.NamedExpr))
+                    and sub.value is not None
+                    and expr_tainted(sub.value)
+                ):
+                    new = assigned_names(sub.target)
+                elif isinstance(sub, ast.Call):
+                    # container.append(device_value) taints the container.
+                    name = dotted_name(sub.func)
+                    if (
+                        name
+                        and "." in name
+                        and name.rsplit(".", 1)[-1] in ("append", "appendleft", "extend", "add")
+                        and any(expr_tainted(a) for a in sub.args)
+                    ):
+                        new = [name.split(".", 1)[0]]
+                for n in new:
+                    if n not in tainted:
+                        tainted.add(n)
+                        changed = True
+        return tainted
+
+def structural_taint(e: ast.AST, tainted: set[str], seed_call=None) -> bool:
+    """Device-value taint of one expression, structural: device-ness flows
+    through *reads* (attributes, subscripts, calls on a tainted object,
+    collections containing one) but NOT through passing a tainted value as an
+    argument to an unknown function — whose return is usually host-side (the
+    runner's finalize/retry helpers return numpy). ``seed_call`` optionally
+    marks calls whose results are device values (jnp/jax + the configured
+    engine entry points); the JX002 sync-site check omits it, asking only
+    whether an already-tainted name flows in."""
+    if isinstance(e, ast.Name):
+        return isinstance(e.ctx, ast.Load) and e.id in tainted
+    if isinstance(e, ast.Call):
+        if seed_call is not None and seed_call(e):
+            return True
+        return structural_taint(e.func, tainted, seed_call)
+    if isinstance(e, (ast.Attribute, ast.Starred)):
+        return structural_taint(e.value, tainted, seed_call)
+    if isinstance(e, ast.Subscript):
+        return structural_taint(e.value, tainted, seed_call)
+    if isinstance(e, ast.BinOp):
+        return structural_taint(e.left, tainted, seed_call) or structural_taint(
+            e.right, tainted, seed_call
+        )
+    if isinstance(e, ast.UnaryOp):
+        return structural_taint(e.operand, tainted, seed_call)
+    if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+        return any(structural_taint(v, tainted, seed_call) for v in e.elts)
+    if isinstance(e, ast.Compare):
+        return structural_taint(e.left, tainted, seed_call) or any(
+            structural_taint(c, tainted, seed_call) for c in e.comparators
+        )
+    if isinstance(e, ast.BoolOp):
+        return any(structural_taint(v, tainted, seed_call) for v in e.values)
+    if isinstance(e, ast.IfExp):
+        return any(
+            structural_taint(v, tainted, seed_call)
+            for v in (e.test, e.body, e.orelse)
+        )
+    return False
+
+
+def _for_target_taint(target: ast.AST, it: ast.AST, expr_tainted) -> list[str]:
+    """Names a For loop taints, structure-aware: iterating ``d.items()``
+    yields static keys and tainted values, ``zip(a, b)`` taints per argument,
+    ``enumerate(x)`` never taints the counter, ``range(...)`` taints nothing.
+    Everything else falls back to all-or-nothing on the iterable's taint."""
+    names = assigned_names(target)
+    call_name = dotted_name(it.func) if isinstance(it, ast.Call) else None
+    leaf = call_name.rsplit(".", 1)[-1] if call_name else None
+    elts = target.elts if isinstance(target, (ast.Tuple, ast.List)) else None
+    if leaf == "range":
+        return []
+    if leaf == "keys":
+        return []
+    if leaf == "items" and elts is not None and len(elts) == 2:
+        assert isinstance(it, ast.Call)
+        return assigned_names(elts[1]) if expr_tainted(it.func) else []
+    if (
+        leaf == "zip"
+        and elts is not None
+        and isinstance(it, ast.Call)
+        and len(elts) == len(it.args)
+    ):
+        out: list[str] = []
+        for elt, arg in zip(elts, it.args):
+            if expr_tainted(arg):
+                out.extend(assigned_names(elt))
+        return out
+    if (
+        leaf == "enumerate"
+        and elts is not None
+        and len(elts) == 2
+        and isinstance(it, ast.Call)
+        and it.args
+    ):
+        return assigned_names(elts[1]) if expr_tainted(it.args[0]) else []
+    return names if expr_tainted(it) else []
+
+
+def _expr_tainted(e: ast.AST, tainted: set[str]) -> bool:
+    """Tracer taint of one expression (JX001): conservative, but static
+    metadata reads, host casts and None-comparisons launder."""
+    if isinstance(e, ast.Name):
+        return isinstance(e.ctx, ast.Load) and e.id in tainted
+    if isinstance(e, ast.Constant):
+        return False
+    if isinstance(e, ast.Attribute):
+        if e.attr in _STATIC_ATTRS:
+            return False
+        return _expr_tainted(e.value, tainted)
+    if isinstance(e, ast.Call):
+        name = dotted_name(e.func)
+        leaf = name.rsplit(".", 1)[-1] if name else ""
+        if leaf in _HOST_CASTS:
+            return False
+        if name is not None:
+            root = name.split(".", 1)[0]
+            if root in ("jnp",) or root == "jax" or ".lax" in name:
+                return True
+        return any(
+            _expr_tainted(a, tainted)
+            for a in list(e.args) + [kw.value for kw in e.keywords]
+        ) or _expr_tainted(e.func, tainted)
+    if isinstance(e, ast.Compare):
+        # ``x is None`` / ``x is not None`` are trace-time-static checks.
+        if all(
+            isinstance(c, ast.Constant) and c.value is None for c in e.comparators
+        ) and all(isinstance(op, (ast.Is, ast.IsNot)) for op in e.ops):
+            return False
+        return _expr_tainted(e.left, tainted) or any(
+            _expr_tainted(c, tainted) for c in e.comparators
+        )
+    if isinstance(e, (ast.BoolOp, ast.JoinedStr)):
+        return any(_expr_tainted(v, tainted) for v in e.values)
+    if isinstance(e, ast.BinOp):
+        return _expr_tainted(e.left, tainted) or _expr_tainted(e.right, tainted)
+    if isinstance(e, ast.UnaryOp):
+        return _expr_tainted(e.operand, tainted)
+    if isinstance(e, ast.Subscript):
+        return _expr_tainted(e.value, tainted) or _expr_tainted(e.slice, tainted)
+    if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+        return any(_expr_tainted(v, tainted) for v in e.elts)
+    if isinstance(e, ast.IfExp):
+        return any(
+            _expr_tainted(v, tainted) for v in (e.test, e.body, e.orelse)
+        )
+    if isinstance(e, ast.Starred):
+        return _expr_tainted(e.value, tainted)
+    return False

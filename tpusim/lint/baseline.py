@@ -1,0 +1,61 @@
+"""Baseline file: grandfathered findings the CI gate tolerates.
+
+The file is committed JSON — a sorted list of fingerprint records plus the
+rule/path/message at write time (for humans reading the diff; matching uses
+only the fingerprint). ``tpusim lint --baseline FILE`` subtracts matching
+findings; ``--write-baseline`` rewrites the file from the current findings,
+which is also how a fixed finding leaves the baseline (the shrinking diff is
+the progress record).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from .findings import Finding, fingerprint_findings
+
+
+class Baseline:
+    VERSION = 1
+
+    def __init__(self, fingerprints: set[str] | None = None):
+        self.fingerprints = fingerprints or set()
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        if not path.exists():
+            return cls()
+        data = json.loads(path.read_text())
+        if data.get("version") != cls.VERSION:
+            raise ValueError(
+                f"baseline {path} has version {data.get('version')!r}; this "
+                f"tpusim-lint reads version {cls.VERSION} — regenerate with "
+                f"--write-baseline"
+            )
+        return cls({rec["fingerprint"] for rec in data.get("findings", [])})
+
+    @staticmethod
+    def write(path: Path, findings: list[Finding]) -> None:
+        records = [
+            {
+                "fingerprint": fp,
+                "rule": f.rule,
+                "path": f.path,
+                "line": f.line,  # informational: matching ignores it
+                "message": f.message,
+            }
+            for f, fp in fingerprint_findings(findings)
+        ]
+        records.sort(key=lambda r: r["fingerprint"])
+        path.write_text(
+            json.dumps({"version": Baseline.VERSION, "findings": records}, indent=2)
+            + "\n"
+        )
+
+    def split(self, findings: list[Finding]) -> tuple[list[Finding], list[Finding]]:
+        """(new, grandfathered) — new findings fail the gate."""
+        new, old = [], []
+        for f, fp in fingerprint_findings(findings):
+            (old if fp in self.fingerprints else new).append(f)
+        return new, old

@@ -1,0 +1,169 @@
+"""``tpusim lint`` CLI: walk the configured file set, apply the rules, and
+gate on the baseline.
+
+Exit codes: 0 = no non-baselined findings, 1 = new findings (the CI gate),
+2 = usage error. ``--write-baseline`` regenerates the committed baseline
+from the current findings and exits 0 — the workflow for grandfathering.
+
+    python -m tpusim.cli lint --baseline .tpusim-lint-baseline.json
+    python -m tpusim.cli lint tpusim/engine.py --rules JX002,JX003
+    python -m tpusim.cli lint --list-rules
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .baseline import Baseline
+from .config import load_config
+from .rules import ALL_RULES, lint_paths
+
+
+def _repo_root() -> Path:
+    """The project being linted: nearest ancestor of the CWD with a
+    pyproject.toml (so an installed tpusim lints the checkout it is run *in*,
+    not its own site-packages), falling back to this package's checkout."""
+    cur = Path.cwd().resolve()
+    for cand in (cur, *cur.parents):
+        if (cand / "pyproject.toml").exists():
+            return cand
+    return Path(__file__).resolve().parents[2]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpusim lint", description=__doc__)
+    p.add_argument(
+        "paths", nargs="*", type=Path,
+        help="files or directories to lint (default: the include globs of "
+        "[tool.tpusim-lint] in pyproject.toml)",
+    )
+    p.add_argument(
+        "--baseline", type=Path, metavar="FILE",
+        help="subtract grandfathered findings recorded in FILE; exit 1 only "
+        "on new ones",
+    )
+    p.add_argument(
+        "--write-baseline", type=Path, metavar="FILE",
+        help="rewrite FILE from the current findings and exit 0",
+    )
+    p.add_argument(
+        "--rules", type=str, default=None,
+        help="comma-separated rule ids to run (default: enabled-rules config)",
+    )
+    p.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="finding output format",
+    )
+    p.add_argument("--list-rules", action="store_true", help="print the rule table")
+    p.add_argument("--quiet", action="store_true", help="suppress the summary line")
+    return p
+
+
+def _collect_files(args, root: Path, config) -> list[Path]:
+    if args.paths:
+        # Directories expand under the include/exclude config (so
+        # `lint tpusim` and the bare CI invocation agree on the file set);
+        # an explicitly named FILE is linted unconditionally — the user
+        # asked for it by name. Deduplicated: a repeated path must not
+        # double findings (and shift baseline occurrence indices).
+        files: list[Path] = []
+        seen: set[Path] = set()
+
+        def add(f: Path) -> None:
+            f = f.resolve()
+            if f not in seen:
+                seen.add(f)
+                files.append(f)
+
+        for p in args.paths:
+            p = p if p.is_absolute() else Path.cwd() / p
+            if p.is_dir():
+                for f in sorted(p.rglob("*.py")):
+                    try:
+                        rel = f.resolve().relative_to(root.resolve()).as_posix()
+                    except ValueError:
+                        add(f)  # outside the project: no config opinion
+                        continue
+                    if config.is_included(rel):
+                        add(f)
+            elif p.exists():
+                add(p)
+            else:
+                raise SystemExit(f"error: no such path: {p}")
+        return files
+    files = []
+    for pattern in config.include:
+        files.extend(root.glob(pattern))
+    out = []
+    for f in sorted(set(files)):
+        rel = f.relative_to(root).as_posix()
+        if config.is_included(rel):
+            out.append(f)
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule_id, (_, desc) in sorted(ALL_RULES.items()):
+            print(f"{rule_id}  {desc}")
+        return 0
+    root = _repo_root()
+    config = load_config(root / "pyproject.toml")
+    rules = None
+    if args.rules:
+        rules = [r.strip().upper() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in ALL_RULES]
+        if unknown:
+            print(f"error: unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
+            return 2
+    files = _collect_files(args, root, config)
+    findings = lint_paths(files, root, config=config, rules=rules)
+
+    if args.write_baseline:
+        Baseline.write(args.write_baseline, findings)
+        if not args.quiet:
+            print(
+                f"wrote {len(findings)} finding(s) to baseline "
+                f"{args.write_baseline}"
+            )
+        return 0
+
+    grandfathered: list = []
+    if args.baseline:
+        try:
+            bl = Baseline.load(args.baseline)
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        findings, grandfathered = bl.split(findings)
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "new": [vars(f) for f in findings],
+                    "baselined": len(grandfathered),
+                    "files": len(files),
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+    if not args.quiet and args.format == "text":
+        base = f" ({len(grandfathered)} baselined)" if args.baseline else ""
+        print(
+            f"tpusim-lint: {len(findings)} new finding(s) in {len(files)} "
+            f"file(s){base}",
+            file=sys.stderr,
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,114 @@
+"""Lint configuration: the ``[tool.tpusim-lint]`` block of pyproject.toml.
+
+Defaults are this repository's real layout — the linter is project-aware by
+construction, and the config block exists so the knowledge lives in ONE
+committed place instead of being hardcoded across rules:
+
+  * ``include``/``exclude`` — which files a bare ``tpusim lint`` walks;
+  * ``hot_modules`` — dispatch hot paths where an implicit host sync (JX002)
+    stalls the device pipeline;
+  * ``device_modules`` — pure device-math modules where any ``time``/
+    ``random`` host call (JX007) is a determinism bug;
+  * ``unused_globs`` — where the unused-reachability pass (JX008) applies
+    (scripts only: package modules export public API the pass cannot see);
+  * ``device_call_patterns`` — method-name substrings whose call results are
+    device values for the JX002 taint (the engine's jitted entry points);
+  * ``prng_consumers`` — extra PRNG-consuming callables for JX004 beyond
+    ``jax.random.*`` (the xoroshiro sequential generator).
+
+TOML parsing uses the stdlib ``tomllib`` when present (3.11+) and falls back
+to ``tomli`` on 3.10; with neither available the committed defaults below
+apply unchanged (they ARE this repo's pyproject block), so the gate still
+runs — it just cannot pick up local config edits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+from pathlib import Path
+
+try:  # Python 3.11+
+    import tomllib as _toml
+except ImportError:  # 3.10: the container ships tomli
+    try:
+        import tomli as _toml  # type: ignore[no-redef]
+    except ImportError:  # pragma: no cover - neither present
+        _toml = None
+
+# NOTE: pathlib's ``**`` does not cover the zero-directory case on 3.10, so
+# the package root needs its own glob next to the recursive one.
+_DEFAULT_INCLUDE = ("tpusim/*.py", "tpusim/**/*.py", "scripts/*.py", "bench.py")
+_DEFAULT_EXCLUDE = ("tpusim/lint/*.py",)
+_DEFAULT_HOT = (
+    "tpusim/engine.py",
+    "tpusim/pallas_engine.py",
+    "tpusim/runner.py",
+    "bench.py",
+)
+_DEFAULT_DEVICE = (
+    "tpusim/state.py",
+    "tpusim/sampling.py",
+    "tpusim/xoroshiro.py",
+    "tpusim/engine.py",
+    "tpusim/pallas_engine.py",
+)
+_DEFAULT_UNUSED = ("scripts/*.py",)
+_DEFAULT_DEVICE_CALLS = (
+    "_pipe_chunk",
+    "_chunk",
+    "_init",
+    "_finalize",
+    "_run_device",
+    "run_batch_async",
+)
+_DEFAULT_PRNG_CONSUMERS = ("next_words",)
+_ALL_RULE_IDS = tuple(f"JX{n:03d}" for n in range(1, 9))
+
+
+@dataclasses.dataclass(frozen=True)
+class LintConfig:
+    include: tuple[str, ...] = _DEFAULT_INCLUDE
+    exclude: tuple[str, ...] = _DEFAULT_EXCLUDE
+    enabled_rules: tuple[str, ...] = _ALL_RULE_IDS
+    hot_modules: tuple[str, ...] = _DEFAULT_HOT
+    device_modules: tuple[str, ...] = _DEFAULT_DEVICE
+    unused_globs: tuple[str, ...] = _DEFAULT_UNUSED
+    device_call_patterns: tuple[str, ...] = _DEFAULT_DEVICE_CALLS
+    prng_consumers: tuple[str, ...] = _DEFAULT_PRNG_CONSUMERS
+
+    def matches(self, rel_path: str, globs: tuple[str, ...]) -> bool:
+        rel = rel_path.replace("\\", "/")
+        return any(fnmatch.fnmatch(rel, g) for g in globs)
+
+    def is_included(self, rel_path: str) -> bool:
+        return self.matches(rel_path, self.include) and not self.matches(
+            rel_path, self.exclude
+        )
+
+
+def load_config(pyproject: Path | None = None) -> LintConfig:
+    """Read ``[tool.tpusim-lint]`` from ``pyproject`` (or the repo root's).
+    Missing file, missing block, or no TOML parser all yield the defaults —
+    the linter must run in a bare checkout."""
+    if pyproject is None:
+        pyproject = Path(__file__).resolve().parents[2] / "pyproject.toml"
+    if _toml is None or not pyproject.exists():
+        return LintConfig()
+    with pyproject.open("rb") as fh:
+        data = _toml.load(fh)
+    block = data.get("tool", {}).get("tpusim-lint", {})
+    kwargs = {}
+    for field, key in (
+        ("include", "include"),
+        ("exclude", "exclude"),
+        ("enabled_rules", "enabled-rules"),
+        ("hot_modules", "hot-modules"),
+        ("device_modules", "device-modules"),
+        ("unused_globs", "unused-globs"),
+        ("device_call_patterns", "device-call-patterns"),
+        ("prng_consumers", "prng-consumers"),
+    ):
+        if key in block:
+            kwargs[field] = tuple(str(v) for v in block[key])
+    return LintConfig(**kwargs)

@@ -1,0 +1,104 @@
+"""Finding record + the suppression-comment scanner.
+
+A finding's *fingerprint* is deliberately line-number-free: ``(rule, path,
+normalized source line, occurrence index)``. Baselined findings must survive
+unrelated edits above them — a fingerprint keyed on line numbers would
+invalidate the whole baseline on every insertion, and one keyed on the raw
+line would churn on re-indents.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+#: ``# tpusim-lint: disable=JX001,JX003 -- optional reason``
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpusim-lint:\s*disable=(?P<rules>[A-Za-z0-9,\s]+?)"
+    r"(?:\s*--\s*(?P<reason>.*))?\s*$"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str  # "JX001" .. "JX008"
+    path: str  # repo-relative posix path
+    line: int  # 1-based
+    col: int  # 0-based
+    message: str
+    source_line: str = ""  # stripped text of the offending line
+
+    def fingerprint(self, occurrence: int) -> str:
+        norm = " ".join(self.source_line.split())
+        return f"{self.rule}|{self.path}|{norm}|{occurrence}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}: {self.rule} {self.message}"
+
+
+def fingerprint_findings(findings: list[Finding]) -> list[tuple[Finding, str]]:
+    """Pair every finding with its occurrence-indexed fingerprint: the i-th
+    finding of the same (rule, path, normalized line) gets occurrence i, so
+    two identical offending lines in one file baseline independently."""
+    seen: dict[str, int] = {}
+    out = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        key = f.fingerprint(0).rsplit("|", 1)[0]
+        occ = seen.get(key, 0)
+        seen[key] = occ + 1
+        out.append((f, f"{key}|{occ}"))
+    return out
+
+
+class Suppressions:
+    """Per-line suppression sets parsed from the raw source.
+
+    A trailing comment suppresses its own line. A comment that is the only
+    content of its line suppresses the *next* line — the idiom for statements
+    too long to annotate in place. ``disable=all`` suppresses every rule.
+    """
+
+    def __init__(self, source: str):
+        self._by_line: dict[int, set[str]] = {}
+        lines = source.splitlines()
+        for lineno, text in enumerate(lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = {
+                r.strip().upper() for r in m.group("rules").split(",") if r.strip()
+            }
+            target = lineno
+            if text.lstrip().startswith("#"):
+                # Comment-only line: the suppression covers the next CODE
+                # line — reason strings may wrap over several comment lines.
+                target = lineno + 1
+                while target <= len(lines) and (
+                    not lines[target - 1].strip()
+                    or lines[target - 1].lstrip().startswith("#")
+                ):
+                    target += 1
+            self._by_line.setdefault(target, set()).update(rules)
+
+    def extend_spans(self, tree) -> None:
+        """Widen each suppression to the full extent of any statement that
+        STARTS on its target line: findings anchor on the AST node's line,
+        which for a black-formatted multi-line statement can be a
+        continuation line of the statement the comment covers."""
+        import ast
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.stmt):
+                continue
+            start = getattr(node, "lineno", None)
+            end = getattr(node, "end_lineno", None)
+            if start is None or end is None or end <= start:
+                continue
+            rules = self._by_line.get(start)
+            if rules:
+                for line in range(start + 1, end + 1):
+                    self._by_line.setdefault(line, set()).update(rules)
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        rules = self._by_line.get(line)
+        return bool(rules) and (rule.upper() in rules or "ALL" in rules)

@@ -1,0 +1,507 @@
+"""The rule implementations and the file-walking entry points.
+
+Every rule is a function ``(ModuleAnalysis) -> Iterator[Finding]``; the
+registry maps rule ids to (function, one-line description). Precision over
+recall: each rule targets the exact failure mode this stack has hit (or
+nearly hit) — the suppression syntax and the baseline file absorb the
+judgment calls, so a rule firing is worth reading.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Callable, Iterable, Iterator
+
+from .analysis import ModuleAnalysis, assigned_names, dotted_name, structural_taint
+from .config import LintConfig
+from .findings import Finding
+
+#: jax.random functions that *derive* new key material rather than consuming
+#: a key for draws — the sanctioned ways to reuse a name.
+_KEY_DERIVERS = frozenset({
+    "split", "fold_in", "key", "PRNGKey", "wrap_key_data", "key_data", "clone",
+})
+
+#: Host-sync call forms JX002 recognizes.
+_SYNC_NP_FUNCS = frozenset({"np.asarray", "np.array", "numpy.asarray", "numpy.array"})
+_SYNC_CASTS = frozenset({"int", "float", "bool"})
+
+#: float64-inviting references JX005 flags inside jitted math.
+_DTYPE_DRIFT_ATTRS = frozenset({
+    "np.float64", "np.int64", "np.double", "np.longdouble",
+    "numpy.float64", "numpy.int64", "jnp.float64", "jnp.int64",
+})
+
+_JNP_ARRAY_MAKERS = frozenset({"array", "asarray", "full", "full_like"})
+
+
+def _finding(ma: ModuleAnalysis, rule: str, node: ast.AST, message: str) -> Finding:
+    line = getattr(node, "lineno", 1)
+    col = getattr(node, "col_offset", 0)
+    text = ma.lines[line - 1].strip() if 0 < line <= len(ma.lines) else ""
+    return Finding(rule, ma.path, line, col, message, text)
+
+
+# ---------------------------------------------------------------------------
+# JX001 — Python control flow on tracer values in jit-reachable code.
+
+
+def check_tracer_branch(ma: ModuleAnalysis) -> Iterator[Finding]:
+    from .analysis import _expr_tainted
+
+    for info in ma.jit_entered_functions():
+        tainted = ma.tracer_tainted_names(info)
+        for node in ast.walk(info.node):
+            if isinstance(node, (ast.If, ast.While)) and _expr_tainted(
+                node.test, tainted
+            ):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                yield _finding(
+                    ma, "JX001", node,
+                    f"Python `{kind}` on a tracer-typed value inside "
+                    f"jit-reachable `{info.qualname}` — this forces a trace-time "
+                    f"branch (ConcretizationTypeError or silent retrace per "
+                    f"value); use jnp.where/lax.cond",
+                )
+
+
+# ---------------------------------------------------------------------------
+# JX002 — implicit host sync in engine/runner hot loops.
+
+
+def check_host_sync(ma: ModuleAnalysis) -> Iterator[Finding]:
+    if not ma.config.matches(ma.path, tuple(ma.config.hot_modules)):
+        return
+    # Module top level included: a script's main loop is a hot loop too.
+    for func in [f.node for f in ma.funcs] + [ma.tree]:
+        tainted = ma.device_tainted_names(func)
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            leaf = name.rsplit(".", 1)[-1]
+            in_loop = ma.inside_loop(node)
+            if leaf == "block_until_ready":
+                yield _finding(
+                    ma, "JX002", node,
+                    "`.block_until_ready()` blocks the dispatch pipeline; "
+                    "outside profiling code the transfer at use time is the "
+                    "only sync needed",
+                )
+            elif not in_loop:
+                continue
+            elif leaf == "item" and not node.args:
+                yield _finding(
+                    ma, "JX002", node,
+                    "`.item()` inside a hot loop synchronously fetches one "
+                    "scalar per iteration — batch the transfer outside the loop",
+                )
+            elif name in _SYNC_NP_FUNCS or name == "jax.device_get":
+                yield _finding(
+                    ma, "JX002", node,
+                    f"`{name}` inside a hot loop forces a device→host "
+                    f"transfer per iteration",
+                )
+            elif (
+                name in _SYNC_CASTS
+                and len(node.args) == 1
+                and structural_taint(node.args[0], tainted)
+            ):
+                yield _finding(
+                    ma, "JX002", node,
+                    f"`{name}()` on a device value inside a hot loop blocks "
+                    f"until the device catches up — the implicit host sync "
+                    f"that serializes pipelined dispatch",
+                )
+
+
+# ---------------------------------------------------------------------------
+# JX003 — use-after-donation.
+
+
+def check_use_after_donation(ma: ModuleAnalysis) -> Iterator[Finding]:
+    if not any(jc.donate for jc in ma.jitted.values()):
+        return
+    # Module top level is a scope too: scripts donate at module scope.
+    for func in [f.node for f in ma.funcs] + [ma.tree]:
+        # Own scope only (like JX004): a same-named local in a nested closure
+        # is a different binding — it must neither mask a real
+        # use-after-donation here nor be flagged against this scope's calls.
+        stores: dict[str, list[int]] = {}
+        for node in ma.own_nodes(func):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                stores.setdefault(node.id, []).append(node.lineno)
+        own = list(ma.own_nodes(func))
+        for node in own:
+            if not isinstance(node, ast.Call):
+                continue
+            jc = ma.resolve_jitted(node.func)
+            if jc is None or not jc.donate:
+                continue
+            donated = [
+                node.args[pos].id
+                for pos in jc.donate
+                if pos < len(node.args) and isinstance(node.args[pos], ast.Name)
+            ]
+            if not donated:
+                continue
+            call_line = node.lineno
+            # The call's own extent: its argument reads (which may sit on
+            # later physical lines when the call is formatted multi-line)
+            # are the donation itself, not a use-after.
+            in_call = {id(n) for n in ast.walk(node)}
+            call_end = getattr(node, "end_lineno", None) or call_line
+            for read in own:
+                if (
+                    isinstance(read, ast.Name)
+                    and isinstance(read.ctx, ast.Load)
+                    and id(read) not in in_call
+                    and read.id in donated
+                    and read.lineno > call_end
+                    and not any(
+                        call_line <= s <= read.lineno for s in stores.get(read.id, [])
+                    )
+                    # A read in the opposite arm of an if/else never executes
+                    # after this donating call.
+                    and not ma.mutually_exclusive(node, read, func)
+                ):
+                    yield _finding(
+                        ma, "JX003", read,
+                        f"`{read.id}` was donated to `{jc.key}` (donate_argnums, "
+                        f"line {call_line}) and read afterwards — the buffer is "
+                        f"deleted on dispatch; reading it raises (or worse, on "
+                        f"some backends, returns garbage)",
+                    )
+            # Donation inside a loop with NO rebind of the name anywhere in
+            # the loop body: iteration n+1's reads — including ones lexically
+            # BEFORE the call — see the buffer iteration n donated. (The
+            # sanctioned pattern rebinds on the call line: `s, ... = f(s, ...)`.)
+            loop = ma.enclosing_loop(node)
+            if loop is None:
+                continue
+            for dname in donated:
+                if any(
+                    isinstance(n, ast.Name)
+                    and isinstance(n.ctx, ast.Store)
+                    and n.id == dname
+                    for n in ast.walk(loop)
+                ):
+                    continue
+                for read in ast.walk(loop):
+                    if (
+                        isinstance(read, ast.Name)
+                        and isinstance(read.ctx, ast.Load)
+                        and read.id == dname
+                        and read.lineno < call_line  # later reads: flagged above
+                    ):
+                        yield _finding(
+                            ma, "JX003", read,
+                            f"`{read.id}` is donated to `{jc.key}` later in "
+                            f"this loop body (line {call_line}) and never "
+                            f"rebound in the loop — on the next iteration "
+                            f"this read touches the donated buffer",
+                        )
+
+
+# ---------------------------------------------------------------------------
+# JX004 — PRNG key consumed twice without split/fold_in.
+
+
+def check_key_reuse(ma: ModuleAnalysis) -> Iterator[Finding]:
+    extra_consumers = set(ma.config.prng_consumers)
+    for func in [f.node for f in ma.funcs] + [ma.tree]:
+        # Own scope only: a same-named key in a sibling nested function is a
+        # different binding, not a reuse of this one.
+        stores: dict[str, list[int]] = {}
+        for node in ma.own_nodes(func):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                stores.setdefault(node.id, []).append(node.lineno)
+        consumed: dict[str, ast.Call] = {}  # name -> last consumption site
+        calls = [n for n in ma.own_nodes(func) if isinstance(n, ast.Call)]
+        for node in sorted(calls, key=lambda n: (n.lineno, n.col_offset)):
+            name = dotted_name(node.func) or ""
+            leaf = name.rsplit(".", 1)[-1]
+            consuming = (
+                name.startswith("jax.random.") and leaf not in _KEY_DERIVERS
+            ) or leaf in extra_consumers
+            if not consuming or not node.args:
+                continue
+            key_arg = node.args[0]
+            if not isinstance(key_arg, ast.Name):
+                continue
+            kname = key_arg.id
+            prev = consumed.get(kname)
+            if (
+                prev is not None
+                and not any(
+                    prev.lineno <= s <= node.lineno for s in stores.get(kname, [])
+                )
+                # if/else arms each consume once per execution — not a reuse.
+                and not ma.mutually_exclusive(prev, node, func)
+            ):
+                yield _finding(
+                    ma, "JX004", node,
+                    f"PRNG state `{kname}` consumed again (previously at line "
+                    f"{prev.lineno}) without split/fold_in/advance — identical "
+                    f"draws, silently correlated streams",
+                )
+            consumed[kname] = node
+            # Consumption inside a loop with no per-iteration rebind reuses
+            # the same state every iteration — unless the key is stored
+            # somewhere in the loop body (a split/fold_in rebind) or derives
+            # from the loop variable.
+            loop = ma.enclosing_loop(node)
+            if loop is not None:
+                loop_vars = ma.loop_targets(node)
+                stored_in_loop = any(
+                    isinstance(n, ast.Name)
+                    and isinstance(n.ctx, ast.Store)
+                    and n.id == kname
+                    for n in ast.walk(loop)
+                )
+                if kname not in loop_vars and not stored_in_loop:
+                    yield _finding(
+                        ma, "JX004", node,
+                        f"PRNG state `{kname}` consumed inside a loop without "
+                        f"being advanced per iteration — every iteration draws "
+                        f"the same values",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# JX005 — dtype drift into jitted math.
+
+
+def check_dtype_drift(ma: ModuleAnalysis) -> Iterator[Finding]:
+    for info in ma.jit_entered_functions():
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Attribute):
+                name = dotted_name(node)
+                if name in _DTYPE_DRIFT_ATTRS:
+                    yield _finding(
+                        ma, "JX005", node,
+                        f"`{name}` inside jit-reachable `{info.qualname}` — "
+                        f"64-bit dtypes are emulated (slowly) on TPU and only "
+                        f"exist under the compat.enable_x64 shim; keep device "
+                        f"math 32-bit",
+                    )
+            elif isinstance(node, ast.Call):
+                cname = dotted_name(node.func) or ""
+                for kw in node.keywords:
+                    if (
+                        kw.arg == "dtype"
+                        and isinstance(kw.value, ast.Name)
+                        and kw.value.id in ("float", "int")
+                    ):
+                        yield _finding(
+                            ma, "JX005", kw.value,
+                            f"builtin `{kw.value.id}` as dtype in jit-reachable "
+                            f"`{info.qualname}` resolves to 64-bit under "
+                            f"enable_x64 — name an explicit 32-bit dtype",
+                        )
+                root, _, leaf = cname.partition(".")
+                # Only when the float literal is the LAST positional arg: a
+                # trailing positional (jnp.asarray(0.5, jnp.float32)) or
+                # dtype= keyword pins the dtype explicitly.
+                if (
+                    root == "jnp"
+                    and leaf in _JNP_ARRAY_MAKERS
+                    and not any(kw.arg == "dtype" for kw in node.keywords)
+                    and node.args
+                    and isinstance(node.args[-1], ast.Constant)
+                    and isinstance(node.args[-1].value, float)
+                ):
+                    yield _finding(
+                        ma, "JX005", node,
+                        f"bare Python float literal materialized by `{cname}` "
+                        f"without dtype in jit-reachable `{info.qualname}` — "
+                        f"promotes to float64 under enable_x64",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# JX006 — recompilation risk: jitted callables fed Python scalars in loops.
+
+
+def check_recompile_risk(ma: ModuleAnalysis) -> Iterator[Finding]:
+    if not ma.jitted:
+        return
+    for scope in [f.node for f in ma.funcs] + [ma.tree]:
+        for node in ma.own_nodes(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            jc = ma.resolve_jitted(node.func)
+            if jc is None:
+                continue
+            if not ma.inside_loop(node, comprehensions=False):
+                continue
+            loop_vars = ma.loop_targets(node)
+            for i, arg in enumerate(node.args):
+                if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, (int, float)
+                ):
+                    yield _finding(
+                        ma, "JX006", arg,
+                        f"Python scalar literal at position {i} of jitted "
+                        f"`{jc.key}` inside a loop — weak-typed scalars hash "
+                        f"into the compile cache per value family; pass a "
+                        f"committed-dtype array",
+                    )
+                elif isinstance(arg, ast.Name) and arg.id in loop_vars:
+                    yield _finding(
+                        ma, "JX006", arg,
+                        f"loop variable `{arg.id}` passed raw to jitted "
+                        f"`{jc.key}` — a fresh Python int every iteration "
+                        f"recompiles (or at best re-hashes) per value; wrap it "
+                        f"in jnp.asarray with a pinned dtype",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# JX007 — nondeterministic host calls in device-math modules.
+
+
+def check_nondeterministic_host(ma: ModuleAnalysis) -> Iterator[Finding]:
+    if not ma.config.matches(ma.path, tuple(ma.config.device_modules)):
+        return
+    for node in ast.walk(ma.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.split(".")[0] in ("time", "random", "datetime"):
+                    yield _finding(
+                        ma, "JX007", node,
+                        f"`import {alias.name}` in a device-math module — "
+                        f"wall-clock/host randomness makes device results "
+                        f"unreproducible; keep host I/O in runner/bench",
+                    )
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.module.split(".")[0] in (
+                "time", "random", "datetime",
+            ):
+                yield _finding(
+                    ma, "JX007", node,
+                    f"`from {node.module} import ...` in a device-math module",
+                )
+        elif isinstance(node, ast.Call):
+            name = dotted_name(node.func) or ""
+            if name.startswith(("time.", "random.", "datetime.", "np.random.")):
+                yield _finding(
+                    ma, "JX007", node,
+                    f"nondeterministic host call `{name}` in a device-math "
+                    f"module — results must be a pure function of (config, seed)",
+                )
+
+
+# ---------------------------------------------------------------------------
+# JX008 — unused reachability (dead defs / imports).
+
+
+def check_unused(ma: ModuleAnalysis) -> Iterator[Finding]:
+    if not ma.config.matches(ma.path, tuple(ma.config.unused_globs)):
+        return
+    loads: set[str] = set()
+    strings: set[str] = set()
+    for node in ast.walk(ma.tree):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            loads.add(node.id)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            strings.add(node.value)
+    for node in ma.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            if (
+                node.name not in loads
+                and node.name not in strings  # __all__ / getattr by name
+                and node.name != "main"
+                and not node.decorator_list
+            ):
+                yield _finding(
+                    ma, "JX008", node,
+                    f"`{node.name}` is defined but never referenced in this "
+                    f"module — dead code accretes in scripts; delete it or "
+                    f"note why it must stay",
+                )
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            if isinstance(node, ast.ImportFrom) and node.module == "__future__":
+                continue
+            for alias in node.names:
+                bound = (alias.asname or alias.name).split(".")[0]
+                if alias.name == "*":
+                    continue
+                if bound not in loads and bound not in strings:
+                    yield _finding(
+                        ma, "JX008", node,
+                        f"import `{bound}` is never used in this module",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# Registry + entry points.
+
+RuleFn = Callable[[ModuleAnalysis], Iterator[Finding]]
+
+ALL_RULES: dict[str, tuple[RuleFn, str]] = {
+    "JX001": (check_tracer_branch, "Python if/while on tracer values in jit-reachable code"),
+    "JX002": (check_host_sync, "implicit host sync in engine/runner hot loops"),
+    "JX003": (check_use_after_donation, "read of a buffer after donate_argnums donation"),
+    "JX004": (check_key_reuse, "PRNG state consumed twice without split/advance"),
+    "JX005": (check_dtype_drift, "64-bit dtype drift into jitted math (x64 shim)"),
+    "JX006": (check_recompile_risk, "jitted callable fed Python scalars inside loops"),
+    "JX007": (check_nondeterministic_host, "time/random host calls in device-math modules"),
+    "JX008": (check_unused, "unused module-level defs/imports (scripts)"),
+}
+
+
+def lint_source(
+    source: str,
+    path: str,
+    config: LintConfig | None = None,
+    rules: Iterable[str] | None = None,
+) -> list[Finding]:
+    """Lint one source text as repo-relative ``path``; suppression comments
+    honored, baseline not applied (that is the CLI's job)."""
+    config = config or LintConfig()
+    enabled = tuple(rules) if rules is not None else config.enabled_rules
+    try:
+        ma = ModuleAnalysis(path, source, config)
+    except SyntaxError as e:
+        return [
+            Finding("JX000", path, e.lineno or 1, 0, f"syntax error: {e.msg}")
+        ]
+    findings: list[Finding] = []
+    seen: set[tuple[str, int, int, str]] = set()
+    for rule_id in enabled:
+        entry = ALL_RULES.get(rule_id.upper())
+        if entry is None:
+            continue
+        for f in entry[0](ma):
+            # (rule, line, col) — the same offending node reached through
+            # several enclosing scopes (outer closure + nested def) is ONE
+            # finding.
+            key = (f.rule, f.line, f.col)
+            if key in seen or ma.suppressions.is_suppressed(f.rule, f.line):
+                continue
+            seen.add(key)
+            findings.append(f)
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
+
+
+def lint_paths(
+    paths: Iterable[Path],
+    root: Path,
+    config: LintConfig | None = None,
+    rules: Iterable[str] | None = None,
+) -> list[Finding]:
+    config = config or LintConfig()
+    findings: list[Finding] = []
+    for p in paths:
+        try:
+            rel = p.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:  # outside the repo root: keep the path verbatim
+            rel = p.as_posix()
+        findings.extend(
+            lint_source(p.read_text(), rel, config=config, rules=rules)
+        )
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

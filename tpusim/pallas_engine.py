@@ -425,13 +425,13 @@ def _make_kernel(
                 st.update(npriv=npriv, bhp=bhp, cp=cp)
             return tuple(st[name] for name in names)
 
-        def load(ref, name):
+        def load(ref, name: str):
             val = ref[...]
             if split2 and name in ("garr", "gcnt"):
                 return (val[:, 0, :], val[:, 1, :])
             return val
 
-        def stored(val, name):
+        def stored(val, name: str):
             if split2 and name in ("garr", "gcnt"):
                 # Rebuild the (M, K, R) layout with a K-broadcast select (a
                 # middle-axis concatenate does not lower in Mosaic).

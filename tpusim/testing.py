@@ -10,10 +10,16 @@ literal-chain oracle (tpusim.backend.pychain) on identical event streams.
 mirroring how the reference unit tests construct ``Miner::chain`` literally
 (reference test.cpp:213-367) — so every selfish-strategy case ports as an
 exact-state test of the vectorized kernel.
+
+``compile_count_guard`` is the runtime complement of the JX006 lint rule
+(tpusim.lint): the linter can only flag recompilation *risk* statically; the
+guard pins the actual XLA compile count of a block, so tier-1 tests enforce
+that the headline batch loop compiles exactly once per program shape.
 """
 
 from __future__ import annotations
 
+import contextlib
 from typing import Sequence
 
 import numpy as np
@@ -228,3 +234,76 @@ def assert_state_matches_chains(
     got, want = canonical_view(state, t), canonical_view(expected, t)
     for key in want:
         assert got[key] == want[key], f"{key}: got {got[key]}, want {want[key]}"
+
+
+class CompileCount:
+    """Live counter handed out by :func:`compile_count_guard` — ``count`` is
+    the number of XLA backend compilations observed so far inside the block."""
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.events: list[str] = []
+
+
+@contextlib.contextmanager
+def compile_count_guard(*, exact: int | None = None, max_compiles: int | None = None):
+    """Count XLA backend compilations inside the ``with`` block via
+    ``jax.monitoring``'s duration events, and (optionally) assert on exit.
+
+    This is the enforcement half of the JX006 lint rule: the linter flags
+    *risk* of per-iteration recompilation statically; this guard pins the
+    measured compile count, so a test can state "this batch loop compiles
+    exactly once" as an invariant instead of a hope. Usage::
+
+        with compile_count_guard(exact=0):
+            engine.run_batch(keys)     # warm cache: must NOT recompile
+
+    The counter recognizes the backend-compile duration event across the jax
+    versions this repo supports (``/jax/core/compile/backend_compile_duration``
+    on 0.4.x, ``/jax/backend_compile`` on older releases). Counting happens in
+    THIS process only, and listener registration is process-global in jax —
+    the guard keeps one listener registered forever and gates it with a
+    stack of active counters, because 0.4.x has no public unregister API.
+    """
+    counter = CompileCount()
+    _active_counters.append(counter)
+    try:
+        _ensure_listener()
+        yield counter
+    finally:
+        _active_counters.remove(counter)
+    if exact is not None and counter.count != exact:
+        raise AssertionError(
+            f"expected exactly {exact} XLA compilation(s) in block, observed "
+            f"{counter.count}: {counter.events}"
+        )
+    if max_compiles is not None and counter.count > max_compiles:
+        raise AssertionError(
+            f"expected <= {max_compiles} XLA compilation(s) in block, observed "
+            f"{counter.count}: {counter.events}"
+        )
+
+
+_active_counters: list[CompileCount] = []
+_listener_installed = False
+
+
+def _is_backend_compile_event(name: str) -> bool:
+    return "backend_compile" in name
+
+
+def _ensure_listener() -> None:
+    global _listener_installed
+    if _listener_installed:
+        return
+    import jax.monitoring
+
+    def _on_duration(name: str, secs: float, **kw) -> None:
+        if not _is_backend_compile_event(name):
+            return
+        for counter in _active_counters:
+            counter.count += 1
+            counter.events.append(name)
+
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    _listener_installed = True
