@@ -37,4 +37,24 @@ assert "batch" in names and "run" in names, names
 EOF
 env JAX_PLATFORMS=cpu python -m tpusim report "$tele_dir/smoke.jsonl" > /dev/null
 
+echo "== flight-recorder trace smoke =="
+# One tiny flight-enabled run end-to-end: export the Perfetto trace + JSONL
+# event log, validate the trace schema, and cross-check the event rows
+# against the scalar counters' vocabulary — the cheapest guard against a
+# recorder/export regression landing silently.
+env JAX_PLATFORMS=cpu python -m tpusim trace --runs 2 --batch-size 2 \
+  --duration-ms 86400000 --single-device --quiet --flight-capacity 512 \
+  --trace-out "$tele_dir/smoke.trace.json" --events-out "$tele_dir/events.jsonl"
+env JAX_PLATFORMS=cpu python - "$tele_dir/smoke.trace.json" "$tele_dir/events.jsonl" <<'EOF'
+import json, sys
+from tpusim.flight import KIND_NAMES
+from tpusim.flight_export import validate_perfetto
+trace = json.load(open(sys.argv[1]))
+n = validate_perfetto(trace)
+events = [json.loads(ln) for ln in open(sys.argv[2])]
+assert n == len(events) > 0, (n, len(events))
+assert all(e["kind"] in KIND_NAMES for e in events)
+assert events == sorted(events, key=lambda e: (e["run"], e["seq"]))
+EOF
+
 echo "== CI green =="

@@ -43,4 +43,16 @@ if [ -n "$newest" ]; then
     python -m tpusim report "$newest" --format md \
     --out artifacts/telemetry/sample_report.md > /dev/null
 fi
+# Flight-recorder traces (`tpusim trace --trace-out` exports from hardware
+# windows land next to the ledgers): schema-validate whatever is collected so
+# a corrupt export can't sit silently in the evidence trail.
+traces=$(ls artifacts/telemetry/*.trace.json 2>/dev/null || true)
+if [ -n "$traces" ]; then
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python - $traces <<'EOF'
+import json, sys
+from tpusim.flight_export import validate_perfetto
+for path in sys.argv[1:]:
+    print(f"[harvest] {path}: {validate_perfetto(json.load(open(path)))} events")
+EOF
+fi
 git status --short BASELINE.json REFSCALE.md artifacts/

@@ -142,7 +142,8 @@ def test_device_counters_scan_vs_pallas_equal():
     tele = [k for k in scan if k.startswith("tele_")]
     assert sorted(tele) == [
         "tele_active_steps_sum", "tele_chunks_max",
-        "tele_reorg_depth_max", "tele_stale_events_sum",
+        "tele_reorg_depth_hist_sum", "tele_reorg_depth_max",
+        "tele_stale_by_miner_sum", "tele_stale_events_sum",
     ]
     for name in tele:
         np.testing.assert_array_equal(
@@ -155,6 +156,17 @@ def test_device_counters_scan_vs_pallas_equal():
     slots = int(scan["tele_chunks_max"]) * 64 * config.runs
     occ = int(scan["tele_active_steps_sum"]) / slots
     assert 0.0 < occ <= 1.0
+    # Histogram counters are consistent with their scalar reductions: the
+    # depth histogram's event total is the stale-event count, its deepest
+    # occupied bucket matches reorg_depth_max, and every stale event shows
+    # up for at least one miner.
+    hist = np.asarray(scan["tele_reorg_depth_hist_sum"])
+    assert hist.sum() == int(scan["tele_stale_events_sum"])
+    occupied = np.nonzero(hist)[0]
+    assert occupied[-1] + 1 == min(int(scan["tele_reorg_depth_max"]), len(hist))
+    by_miner = np.asarray(scan["tele_stale_by_miner_sum"])
+    assert by_miner.shape == (config.network.n_miners,)
+    assert by_miner.sum() >= int(scan["tele_stale_events_sum"])
 
 
 def test_combine_sums_merge_rule():
@@ -194,10 +206,26 @@ def test_runner_emits_correlated_spans(tmp_path):
         "start", "runs", "engine", "stall_s", "retries",
         "reorg_depth_max", "stale_events", "active_steps", "chunks", "step_slots",
     }
+    assert isinstance(batch["stale_by_miner"], list)
+    assert isinstance(batch["reorg_depth_hist"], list)
     run = by_name["run"][0]["attrs"]
     assert run["runs"] == SMALL.runs
     assert run["duration_ms"] == SMALL.duration_ms
     assert 0.0 < run["occupancy"] <= 1.0
+    # The closing span is self-describing about its environment (the
+    # ROADMAP's drift note, machine-readable): versions and device identity.
+    import jax as _jax
+
+    import tpusim as _tpusim
+
+    assert run["jax_version"] == _jax.__version__
+    assert run["tpusim_version"] == _tpusim.__version__
+    assert run["device_count"] >= 1 and run["platform"] == "cpu"
+    assert isinstance(run["device_kind"], str) and run["device_kind"]
+    # Run-level histograms are the elementwise fold of the batch spans.
+    assert run["stale_by_miner"] == [
+        sum(v) for v in zip(*(s["attrs"]["stale_by_miner"] for s in by_name["batch"]))
+    ]
     # The run-level counters are the fold of the batch spans.
     assert run["stale_events"] == sum(
         s["attrs"]["stale_events"] for s in by_name["batch"]
@@ -309,6 +337,52 @@ def test_report_multi_run_ledger_groups_throughput():
     assert text.count("4.0") >= 2
     assert '"steady_is_first_batch"' not in text  # rendered as table rows
     assert text.count("steady_runs_per_s") == 2
+
+
+def test_report_spans_only_and_malformed_ledgers_render_no_data():
+    """A spans-only ledger (no batch spans) and foreign spans missing
+    attrs/dur_s must render 'no data' panels instead of raising."""
+    from tpusim.report import render_report
+
+    spans_only = [
+        {"run_id": "x", "span": "checkpoint_save", "t_start": 1.0, "dur_s": 0.1},
+        {"run_id": "x", "span": "run", "t_start": 1.0, "dur_s": 0.2},
+    ]
+    text = render_report(spans_only)
+    assert "no data — ledger has no batch spans" in text
+
+    malformed = [
+        {"run_id": "x", "span": "batch"},          # no attrs, no dur_s
+        {"run_id": "x", "span": "sweep_point"},    # same
+    ]
+    text = render_report(malformed)
+    assert "no data — batch spans carry no stall_s attr" in text
+    assert "Sweep points" in text
+
+
+def test_report_renders_histogram_panels():
+    from tpusim.report import render_report
+
+    spans = [{
+        "run_id": "h", "span": "batch", "t_start": 0.0, "dur_s": 1.0,
+        "attrs": {"runs": 4, "reorg_depth_max": 2, "stale_events": 5,
+                  "active_steps": 10, "step_slots": 20,
+                  "stale_by_miner": [3, 0, 2], "reorg_depth_hist": [4, 1, 0]},
+    }, {
+        "run_id": "h", "span": "batch", "t_start": 1.0, "dur_s": 1.0,
+        "attrs": {"runs": 4, "reorg_depth_max": 1, "stale_events": 1,
+                  "active_steps": 10, "step_slots": 20,
+                  "stale_by_miner": [1, 1, 0], "reorg_depth_hist": [1, 0, 0]},
+    }]
+    text = render_report(spans)
+    assert "Stale events by miner" in text
+    assert "Reorg depth histogram" in text
+    # Elementwise fold across batch spans: miner 0 saw 3 + 1 stale events.
+    lines = text.splitlines()
+    row0 = next(ln for ln in lines if ln.strip().startswith("0 "))
+    assert "4" in row0.split()
+    # The open-ended last bucket is labeled as such.
+    assert any("3+" in ln for ln in lines)
 
 
 def test_report_renders_trace_dir(tmp_path, capsys):

@@ -1,0 +1,377 @@
+"""Flight recorder (tpusim.flight / tpusim.flight_export): consistency with
+the PR-2 scalar counters, scan-vs-pallas bit-equality, ring overflow
+semantics, the zero-capacity compiled-out guarantee, and the ``tpusim
+trace`` export pipeline."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+import jax
+import pytest
+
+from tpusim.config import SimConfig, default_network, reference_selfish_network
+from tpusim.engine import DEPTH_BUCKETS, Engine, combine_sums
+from tpusim.flight import FLIGHT_TIME_BASE, KIND_NAMES, N_FIELDS
+from tpusim.flight_export import (
+    decode_flight,
+    events_jsonl,
+    perfetto_trace,
+    validate_perfetto,
+)
+from tpusim.runner import make_run_keys
+from tpusim.testing import compile_count_guard
+
+#: Racy selfish roster: reorgs, multi-deep pops, mid-chunk freezes — every
+#: event kind and both histogram counters are exercised.
+RACY = SimConfig(
+    network=reference_selfish_network(),
+    duration_ms=2 * 86_400_000,
+    runs=32,
+    batch_size=32,
+    mode="exact",
+    chunk_steps=64,
+    seed=23,
+    flight_capacity=2048,
+)
+
+
+def _decode_all(out, runs):
+    buf = np.asarray(out["flight_buf"])
+    cnt = np.asarray(out["flight_count"])
+    return buf, cnt
+
+
+# ---------------------------------------------------------------------------
+# Consistency against the scalar counters.
+
+
+def test_flight_rows_tie_out_against_counters():
+    """The trace IS the counters, event by event: stale-row count equals
+    tele_stale_events_sum and the per-depth tally of stale rows equals the
+    reorg-depth histogram counter — the cross-check that makes the ring a
+    trustworthy debugging oracle rather than a second opinion."""
+    eng = Engine(RACY)
+    keys = make_run_keys(RACY.seed, 0, RACY.runs)
+    out = eng.run_batch(keys)
+    log = decode_flight(out, start=0)
+    assert not log.dropped  # capacity sized above the 2-day event count
+
+    stale_rows = [e for e in log.events if e["kind"] == "stale"]
+    assert len(stale_rows) == int(out["tele_stale_events_sum"]) > 0
+
+    hist = np.zeros(DEPTH_BUCKETS, np.int64)
+    for e in stale_rows:
+        assert e["depth"] >= 1
+        hist[min(e["depth"], DEPTH_BUCKETS) - 1] += 1
+    np.testing.assert_array_equal(hist, np.asarray(out["tele_reorg_depth_hist_sum"]))
+    assert max(e["depth"] for e in stale_rows) == int(out["tele_reorg_depth_max"])
+
+    # Reorg rows (adoption without losses) carry depth 0 by definition.
+    assert all(e["depth"] == 0 for e in log.events if e["kind"] != "stale")
+
+    # Per-run event times are nondecreasing and bounded by the duration;
+    # kinds decode to the documented vocabulary.
+    by_run: dict[int, list] = {}
+    for e in log.events:
+        by_run.setdefault(e["run"], []).append(e)
+        assert e["kind"] in KIND_NAMES
+        assert 0 <= e["miner"] < RACY.network.n_miners
+    assert sorted(by_run) == list(range(RACY.runs))
+    for r, evs in by_run.items():
+        assert [e["seq"] for e in evs] == list(range(len(evs)))
+        t = [e["t_ms"] for e in evs]
+        assert all(a <= b for a, b in zip(t, t[1:]))
+        assert 0 <= t[-1] <= RACY.duration_ms
+
+
+def test_flight_stats_and_dispatch_paths_unchanged():
+    """Recording must be purely observational: every statistic and counter is
+    bit-identical with the recorder on or off, and the ring itself is
+    dispatch-path-invariant (device loop / pipelined / host loop)."""
+    keys = make_run_keys(RACY.seed, 0, RACY.runs)
+    eng = Engine(RACY)
+    out = eng.run_batch(keys)
+    off = Engine(dataclasses.replace(RACY, flight_capacity=0)).run_batch(keys)
+    assert not any(k.startswith("flight_") for k in off)
+    for k in off:
+        np.testing.assert_array_equal(np.asarray(out[k]), np.asarray(off[k]), err_msg=k)
+    for kwargs in ({"pipelined": True}, {"host_loop": True}):
+        alt = eng.run_batch(keys, **kwargs)
+        np.testing.assert_array_equal(out["flight_buf"], alt["flight_buf"])
+        np.testing.assert_array_equal(out["flight_count"], alt["flight_count"])
+
+
+def test_flight_scan_vs_pallas_bit_equal():
+    """Same masks, same operands, runs-last: the kernel's ring is bit-equal
+    to the scan engine's — on the racy exact config AND on the fast-mode
+    split-slot path."""
+    from tpusim.pallas_engine import PallasEngine
+
+    for config in (
+        dataclasses.replace(RACY, runs=128, batch_size=128, flight_capacity=1024),
+        SimConfig(
+            network=default_network(propagation_ms=10_000),
+            duration_ms=86_400_000, runs=128, batch_size=128, mode="fast",
+            chunk_steps=64, seed=7, flight_capacity=256,
+        ),
+    ):
+        keys = make_run_keys(config.seed, 0, config.runs)
+        scan = Engine(config).run_batch(keys)
+        pallas = PallasEngine(
+            config, tile_runs=128, step_block=32, interpret=True
+        ).run_batch(keys)
+        for k in scan:
+            np.testing.assert_array_equal(
+                np.asarray(scan[k]), np.asarray(pallas[k]), err_msg=k
+            )
+
+
+def test_flight_xoroshiro_records_too():
+    """The sequential-stream A/B mode records through the same plumbing —
+    the cross-backend diff story depends on it (xoroshiro draws are
+    bit-compatible with the native backend)."""
+    config = SimConfig(
+        network=default_network(), duration_ms=86_400_000, runs=8, batch_size=8,
+        chunk_steps=64, seed=5, rng="xoroshiro", flight_capacity=1024,
+    )
+    eng = Engine(config)
+    keys = eng.make_keys(0, config.runs)
+    out = eng.run_batch(keys)
+    hl = eng.run_batch(keys, host_loop=True)
+    np.testing.assert_array_equal(out["flight_buf"], hl["flight_buf"])
+    assert int(np.asarray(out["flight_count"]).min()) > 0
+
+
+# ---------------------------------------------------------------------------
+# Ring overflow.
+
+
+def test_overflow_keeps_newest_rows_with_explicit_dropped():
+    small_cap = 32
+    big = Engine(RACY)
+    small = Engine(dataclasses.replace(RACY, flight_capacity=small_cap))
+    keys = make_run_keys(RACY.seed, 0, RACY.runs)
+    full = decode_flight(big.run_batch(keys), start=0)
+    clipped = decode_flight(small.run_batch(keys), start=0)
+    assert not full.dropped
+    by_run_full: dict[int, list] = {}
+    for e in full.events:
+        by_run_full.setdefault(e["run"], []).append(e)
+    by_run_clip: dict[int, list] = {}
+    for e in clipped.events:
+        by_run_clip.setdefault(e["run"], []).append(e)
+    for r, evs in by_run_full.items():
+        kept = by_run_clip[r]
+        assert len(kept) == small_cap
+        # The NEWEST rows survive, sequence numbers intact, and the dropped
+        # count is explicit — a reader can never mistake a clipped ring for
+        # a complete log.
+        assert clipped.dropped[r] == len(evs) - small_cap > 0
+        assert kept == evs[-small_cap:]
+
+
+def test_combine_sums_concatenates_flight_leaves():
+    a = {"blocks_found_sum": np.array([1]), "tele_chunks_max": np.int64(2),
+         "flight_buf": np.zeros((2, 4, N_FIELDS), np.int32),
+         "flight_count": np.array([3, 4], np.int32)}
+    b = {"blocks_found_sum": np.array([2]), "tele_chunks_max": np.int64(5),
+         "flight_buf": np.ones((1, 4, N_FIELDS), np.int32),
+         "flight_count": np.array([7], np.int32)}
+    m = combine_sums(a, b)
+    assert m["flight_buf"].shape == (3, 4, N_FIELDS)
+    assert m["flight_count"].tolist() == [3, 4, 7]
+    assert int(m["tele_chunks_max"]) == 5
+    assert m["blocks_found_sum"].tolist() == [3]
+
+
+def test_pallas_misaligned_batch_head_tail_split_keeps_flight_rows():
+    """A tile-misaligned batch routes its remainder through the scan twin;
+    the merged output must still carry every run's ring in run order."""
+    from tpusim.pallas_engine import PallasEngine
+
+    config = dataclasses.replace(RACY, runs=160, batch_size=160, flight_capacity=1024)
+    keys = make_run_keys(config.seed, 0, 160)
+    pallas = PallasEngine(config, tile_runs=128, step_block=32, interpret=True)
+    out = pallas.run_batch(keys)  # 128 on the kernel + 32 on the scan twin
+    scan = Engine(config).run_batch(keys)
+    np.testing.assert_array_equal(out["flight_buf"], scan["flight_buf"])
+    np.testing.assert_array_equal(out["flight_count"], scan["flight_count"])
+
+
+# ---------------------------------------------------------------------------
+# Zero-capacity: compiled out, zero cost.
+
+
+def test_capacity_zero_has_no_recorder_ops():
+    """flight_capacity=0 must not merely skip recording — the recorder must
+    not exist in the program: no ring-shaped tensor (the distinctive
+    (7, N_FIELDS) marker), no slot modulo, and a program identical to the
+    default config's."""
+    base = SimConfig(
+        network=default_network(), duration_ms=86_400_000, runs=4, batch_size=4,
+        chunk_steps=64,
+    )
+    keys = make_run_keys(0, 0, 4)
+
+    def loop_jaxpr(config):
+        eng = Engine(config)
+        hi, lo = eng._ledger_init(4)
+        return str(jax.make_jaxpr(lambda k: eng._device_loop(k, hi, lo, eng.params))(keys))
+
+    off = loop_jaxpr(base)
+    off_explicit = loop_jaxpr(dataclasses.replace(base, flight_capacity=0))
+    on = loop_jaxpr(dataclasses.replace(base, flight_capacity=7))
+    marker = f"7,{N_FIELDS}]"  # the (capacity, N_FIELDS) ring leaf shape
+    assert marker in on
+    assert marker not in off
+    assert " rem " not in off  # the slot modulo is the recorder's signature op
+    assert " rem " in on
+    assert off == off_explicit  # default config IS the recorder-less program
+
+    # And the warmed default path stays recompile-free.
+    eng = Engine(base)
+    eng.run_batch(keys)
+    with compile_count_guard(exact=0):
+        eng.run_batch(keys)
+
+
+# ---------------------------------------------------------------------------
+# Export: decode, JSONL, Perfetto, CLI.
+
+
+def test_events_jsonl_is_sorted_and_stable():
+    events = [
+        {"run": 1, "seq": 0, "kind": "find", "t_ms": 5, "miner": 0, "height": 1, "depth": 0},
+        {"run": 0, "seq": 1, "kind": "stale", "t_ms": 9, "miner": 2, "height": 3, "depth": 2},
+        {"run": 0, "seq": 0, "kind": "find", "t_ms": 3, "miner": 1, "height": 1, "depth": 0},
+    ]
+    lines = events_jsonl(events).splitlines()
+    decoded = [json.loads(ln) for ln in lines]
+    assert [(e["run"], e["seq"]) for e in decoded] == [(0, 0), (0, 1), (1, 0)]
+    # Stable key order — the property that makes two backends' logs diffable.
+    assert all(list(e) == ["run", "seq", "kind", "t_ms", "miner", "height", "depth"]
+               for e in decoded)
+
+
+def test_perfetto_trace_schema_and_tracks():
+    eng = Engine(dataclasses.replace(RACY, runs=4, batch_size=4))
+    log = decode_flight(eng.run_batch(make_run_keys(RACY.seed, 0, 4)), start=0)
+    trace = perfetto_trace(
+        log.events, n_miners=RACY.network.n_miners, run_id="abc123",
+    )
+    n = validate_perfetto(trace)
+    assert n == len(log.events) > 0
+    assert trace["otherData"]["run_id"] == "abc123"
+    meta = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+    # One process per run, one named track per miner.
+    assert sum(1 for e in meta if e["name"] == "process_name") == 4
+    assert sum(1 for e in meta if e["name"] == "thread_name") == 4 * RACY.network.n_miners
+    inst = [e for e in trace["traceEvents"] if e["ph"] == "i"]
+    assert all(e["ts"] == 1000 * next(
+        ev["t_ms"] for ev in log.events
+        if (ev["run"], ev["seq"]) == (e["pid"], e["args"]["seq"])
+    ) for e in inst[:50])
+
+    with pytest.raises(ValueError):
+        validate_perfetto({"traceEvents": [{"no": "ph"}]})
+    with pytest.raises(ValueError):
+        validate_perfetto([])
+
+
+def test_trace_cli_end_to_end(tmp_path, capsys):
+    from tpusim.cli import main as cli_main
+
+    trace_out = tmp_path / "t.trace.json"
+    events_out = tmp_path / "ev.jsonl"
+    led = tmp_path / "led.jsonl"
+    rc = cli_main([
+        "trace", "--runs", "3", "--batch-size", "2", "--duration-ms", "86400000",
+        "--single-device", "--flight-capacity", "64",
+        "--trace-out", str(trace_out), "--events-out", str(events_out),
+        "--telemetry", str(led),
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "ui.perfetto.dev" in out
+
+    # --quiet silences the whole summary (scripted CI consumers).
+    rc = cli_main([
+        "trace", "--runs", "1", "--batch-size", "1", "--duration-ms", "86400000",
+        "--single-device", "--quiet", "--flight-capacity", "64",
+        "--trace-out", str(tmp_path / "quiet.trace.json"),
+    ])
+    assert rc == 0
+    assert capsys.readouterr().out == ""
+
+    trace = json.loads(trace_out.read_text())
+    validate_perfetto(trace)
+    # Batching must not break run identity: all three global runs present.
+    pids = {e["pid"] for e in trace["traceEvents"] if e["ph"] != "M"}
+    assert pids == {0, 1, 2}
+
+    events = [json.loads(ln) for ln in events_out.read_text().splitlines()]
+    assert {e["run"] for e in events} == {0, 1, 2}
+    assert all(e["t_ms"] <= 86_400_000 for e in events)
+
+    # The span ledger correlates through the SAME run_id as the trace file.
+    from tpusim.telemetry import load_spans
+
+    spans = load_spans(led)
+    assert [s["span"] for s in spans] == ["trace"]
+    assert spans[0]["run_id"] == trace["otherData"]["run_id"]
+
+    # cpp backend is the diff target, not a recording engine.
+    with pytest.raises(SystemExit):
+        cli_main(["trace", "--backend", "cpp", "--runs", "1"])
+
+
+def test_trace_cli_capacity_precedence(tmp_path, capsys):
+    """--flight-capacity wins over the config file, the config file over the
+    1024 default — a config that sized its own ring is never clobbered."""
+    from tpusim.cli import main as cli_main
+
+    cfg = SimConfig(
+        network=default_network(), duration_ms=86_400_000, runs=1,
+        batch_size=1, flight_capacity=128,
+    )
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(cfg.to_json())
+    led = tmp_path / "led.jsonl"
+
+    def trace_capacity(extra):
+        rc = cli_main([
+            "trace", "--config", str(cfg_path), "--single-device", "--quiet",
+            "--trace-out", str(tmp_path / "t.trace.json"),
+            "--telemetry", str(led), *extra,
+        ])
+        assert rc == 0
+        capsys.readouterr()
+        from tpusim.telemetry import load_spans
+
+        return load_spans(led)[-1]["attrs"]["capacity"]
+
+    assert trace_capacity([]) == 128              # config file honored
+    assert trace_capacity(["--flight-capacity", "64"]) == 64  # flag wins
+
+
+def test_time_limbs_decode_past_int32_chunk_horizon():
+    """A 14-day run crosses the 2^30 ms limb boundary: decoded absolute
+    times must keep increasing monotonically through it (the re-base
+    accumulation carried in the recorder's base limbs)."""
+    config = SimConfig(
+        network=default_network(), duration_ms=14 * 86_400_000, runs=2,
+        batch_size=2, seed=11, flight_capacity=8192,
+    )
+    eng = Engine(config)
+    log = decode_flight(eng.run_batch(eng.make_keys(0, 2)), start=0)
+    assert not log.dropped
+    crossed = False
+    for r in (0, 1):
+        t = [e["t_ms"] for e in log.events if e["run"] == r]
+        assert all(a <= b for a, b in zip(t, t[1:]))
+        assert t[-1] <= config.duration_ms
+        crossed |= t[-1] > FLIGHT_TIME_BASE
+    assert crossed

@@ -1,0 +1,87 @@
+"""Engine sharing across same-shape sweep points (the ROADMAP follow-up on
+sweep.py's per-point ``get_backend("tpu")(config)`` rebuilds): a grid that
+varies only runtime inputs — roster percentages, seed — must compile once,
+and the rebind must actually apply the new point's parameters."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from tpusim.config import SimConfig, default_network
+from tpusim.engine import Engine
+from tpusim.runner import make_engine, run_simulation_config
+from tpusim.sweep import _selfish_network, run_sweep
+from tpusim.testing import compile_count_guard
+
+
+def _cfg(pct: int) -> SimConfig:
+    return SimConfig(
+        network=_selfish_network(pct), duration_ms=86_400_000, runs=8, batch_size=8
+    )
+
+
+def test_same_shape_points_share_one_engine_zero_recompiles():
+    cache: dict = {}
+    a = run_simulation_config(_cfg(25), use_all_devices=False, engine_cache=cache)
+    assert len(cache) == 1
+    # Point two differs only in roster percentages — runtime inputs of the
+    # jitted programs — so the warmed engine serves it without ANY compile.
+    with compile_count_guard(exact=0):
+        b = run_simulation_config(_cfg(40), use_all_devices=False, engine_cache=cache)
+    assert len(cache) == 1
+    # The rebind applied the new params: miner 0's share tracks its hashrate.
+    assert b.miners[0].blocks_share_mean > a.miners[0].blocks_share_mean
+
+
+def test_shape_change_gets_its_own_cache_entry():
+    cache: dict = {}
+    make_engine(_cfg(25), cache=cache)
+    # Different duration -> different chunk budget -> different program.
+    make_engine(dataclasses.replace(_cfg(25), duration_ms=2 * 86_400_000), cache=cache)
+    # Different miner count -> different shapes.
+    make_engine(
+        SimConfig(network=default_network(), duration_ms=86_400_000, runs=8),
+        cache=cache,
+    )
+    assert len(cache) == 3
+
+
+def test_rebind_refuses_cross_shape():
+    eng = make_engine(_cfg(25))
+    other = Engine(SimConfig(network=default_network(), duration_ms=86_400_000, runs=8))
+    with pytest.raises(ValueError, match="rebind across engine shapes"):
+        eng.rebind(other.config, other.reuse_key())
+
+
+def test_run_sweep_uses_shared_cache(tmp_path):
+    """The sweep driver wires the cache through get_backend: an externally
+    provided cache comes back holding the one shared engine, and both
+    points' rows land with their own statistics."""
+    cache: dict = {}
+    points = [("s25", _cfg(25)), ("s40", _cfg(40))]
+    rows = run_sweep(
+        points, out_path=tmp_path / "out.jsonl", quiet=True, engine_cache=cache
+    )
+    assert len(cache) == 1
+    assert [r["point"] for r in rows] == ["s25", "s40"]
+    share = {r["point"]: r["miners"][0]["blocks_share_mean"] for r in rows}
+    assert share["s40"] > share["s25"]
+
+
+def test_pallas_reuse_key_bakes_roster():
+    """The kernel captures thresholds/propagation/selfish as constants, so
+    pallas engines must NOT be shared across rosters — their keys differ
+    where the scan engines' agree."""
+    from tpusim.pallas_engine import PallasEngine
+
+    kw = dict(tile_runs=128, step_block=32, interpret=True)
+    cfg25 = dataclasses.replace(_cfg(25), mode="exact", chunk_steps=64)
+    cfg40 = dataclasses.replace(_cfg(40), mode="exact", chunk_steps=64)
+    assert Engine(cfg25).reuse_key() == Engine(cfg40).reuse_key()
+    k25 = PallasEngine(cfg25, **kw).reuse_key()
+    k40 = PallasEngine(cfg40, **kw).reuse_key()
+    assert k25 != k40
+    assert k25 == PallasEngine(cfg25, **kw).reuse_key()

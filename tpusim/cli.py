@@ -11,9 +11,12 @@ Examples:
     python -m tpusim --config sweep.json --json out.json
     python -m tpusim --runs 1024 --telemetry artifacts/telemetry/run.jsonl
     python -m tpusim report artifacts/telemetry/run.jsonl --format md
+    python -m tpusim trace --runs 4 --days 2 --trace-out flight.trace.json
 
 The ``report`` subcommand (tpusim.report) renders a ``--telemetry`` JSONL
-ledger — or a ``--trace-dir`` XLA trace directory — into a dashboard.
+ledger — or a ``--trace-dir`` XLA trace directory — into a dashboard; the
+``trace`` subcommand (tpusim.flight_export) runs with the device event
+flight recorder on and exports a Perfetto timeline / JSONL event log.
 """
 
 from __future__ import annotations
@@ -169,6 +172,12 @@ def main(argv: list[str] | None = None) -> int:
         from .lint.cli import main as lint_main
 
         return lint_main(argv[1:])
+    if argv and argv[0] == "trace":
+        # Same dispatch rule: run with the event flight recorder enabled and
+        # export a Perfetto timeline / JSONL event log (tpusim.flight_export).
+        from .flight_export import main as trace_main
+
+        return trace_main(argv[1:])
     args = build_parser().parse_args(argv)
     try:
         config = config_from_args(args)

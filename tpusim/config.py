@@ -165,6 +165,13 @@ class SimConfig:
     #: on TPU the uniform->interval mapping is float32-quantized while the
     #: generator words remain bit-exact).
     rng: str = "threefry"
+    #: Per-run event flight-recorder ring capacity (tpusim.flight): rows of
+    #: packed event records kept on device and exportable as a Perfetto
+    #: timeline / JSONL event log (``tpusim trace``). 0 (default) compiles the
+    #: recorder out entirely — no extra carried leaves, no extra ops, jitted
+    #: programs identical to a recorder-less build. NOT part of the sampling
+    #: identity: recording is purely observational.
+    flight_capacity: int = 0
 
     def __post_init__(self) -> None:
         if self.duration_ms <= 0:
@@ -181,6 +188,8 @@ class SimConfig:
             raise ValueError("chunk_steps must be >= 1 (or None for auto)")
         if self.superstep is not None and self.superstep < 1:
             raise ValueError("superstep must be >= 1 (or None for auto)")
+        if self.flight_capacity < 0:
+            raise ValueError("flight_capacity must be >= 0 (0 disables recording)")
         # 32-bit time-arithmetic envelope (see tpusim.state docstring): one
         # interval draw must stay far below INTERVAL_CAP = 2^27 ms, and
         # propagation delays below one chunk re-base span.
@@ -249,6 +258,7 @@ def _config_to_dict(cfg: SimConfig) -> dict[str, Any]:
         "chunk_steps": cfg.chunk_steps,
         "superstep": cfg.superstep,
         "rng": cfg.rng,
+        "flight_capacity": cfg.flight_capacity,
     }
 
 
@@ -275,6 +285,8 @@ def _config_from_dict(d: dict[str, Any]) -> SimConfig:
         kwargs["superstep"] = int(d["superstep"])
     if "mode" in d:
         kwargs["mode"] = str(d["mode"])
+    if "flight_capacity" in d:
+        kwargs["flight_capacity"] = int(d["flight_capacity"])
     if "rng" in d:
         kwargs["rng"] = str(d["rng"])
     return SimConfig(network=network, **kwargs)

@@ -61,7 +61,7 @@ from .state import (
 
 __all__ = [
     "Engine", "SimCounters", "default_n_steps", "resolve_superstep",
-    "DEFAULT_SUPERSTEP",
+    "DEFAULT_SUPERSTEP", "DEPTH_BUCKETS",
 ]
 
 #: Per-batch int32 block-count sums stay exact below this many blocks.
@@ -97,12 +97,19 @@ def resolve_superstep(requested: int | None, divisor: int, *, exact: bool = Fals
     return max(k, 1)
 
 
+#: Reorg-depth histogram buckets: depths 1..DEPTH_BUCKETS-1 get their own
+#: bucket, the last bucket is open-ended (depth >= DEPTH_BUCKETS). Sized so an
+#: honest roster's 1-2-deep races and a selfish roster's burst reveals are
+#: both resolved without widening the carried aux tree meaningfully.
+DEPTH_BUCKETS = 8
+
+
 class SimCounters(NamedTuple):
     """Device-side simulation telemetry, per run, accumulated event-by-event
     in the carried aux tree — the counters ride the same HBM round trip as
     the simulation state (scan carry / VMEM-resident kernel leaves), so
-    collecting them costs one O(M) reduction per event and 12 bytes per run
-    of extra traffic, invisible next to the ~KB state tree.
+    collecting them costs one O(M) reduction per event and ~(12 + 4*(M + 8))
+    bytes per run of extra traffic, invisible next to the ~KB state tree.
 
     The scan engine and the Pallas kernel compute these from the same
     quantities at the same program points, so they are pinned bit-equal by
@@ -119,11 +126,24 @@ class SimCounters(NamedTuple):
     #: The complement is scan steps burned on a frozen run — the quantity
     #: the chunk_steps sizing rationale above reasons about, now measured.
     active_steps: jax.Array  # int32 []
+    #: per-miner stale-event counts: events in which miner m lost >= 1 own
+    #: block (several miners can lose in one event, so the vector's sum can
+    #: exceed ``stale_events``). The per-miner breakdown the aggregate
+    #: dashboards lacked when everything collapsed to max/sum.
+    stale_by_miner: jax.Array  # int32 [M]
+    #: histogram of the per-event max single-adopter pop count (the same
+    #: quantity reorg_max maxes): bucket d-1 counts events of depth d,
+    #: bucket DEPTH_BUCKETS-1 counts depth >= DEPTH_BUCKETS.
+    reorg_depth_hist: jax.Array  # int32 [DEPTH_BUCKETS]
 
 
-def init_counters() -> SimCounters:
+def init_counters(n_miners: int) -> SimCounters:
     z = jnp.zeros((), jnp.int32)
-    return SimCounters(z, z, z)
+    return SimCounters(
+        z, z, z,
+        jnp.zeros((n_miners,), jnp.int32),
+        jnp.zeros((DEPTH_BUCKETS,), jnp.int32),
+    )
 
 
 def _count_step(ctr: SimCounters, old: SimState, new: SimState, cap: jax.Array) -> SimCounters:
@@ -131,11 +151,16 @@ def _count_step(ctr: SimCounters, old: SimState, new: SimState, cap: jax.Array) 
     moves in the notify reorg, so ``new.stale - old.stale`` is exactly the
     per-miner pop count of this event's adoptions (zero when the sweep is
     gated off or the run is frozen)."""
-    dmax = jnp.max(new.stale - old.stale)
+    d = new.stale - old.stale
+    dmax = jnp.max(d)
+    bucket = jnp.minimum(dmax, DEPTH_BUCKETS) - 1
     return SimCounters(
         reorg_max=jnp.maximum(ctr.reorg_max, dmax),
         stale_events=ctr.stale_events + (dmax > 0).astype(jnp.int32),
         active_steps=ctr.active_steps + (old.t < cap).astype(jnp.int32),
+        stale_by_miner=ctr.stale_by_miner + (d > 0).astype(jnp.int32),
+        reorg_depth_hist=ctr.reorg_depth_hist
+        + ((jnp.arange(DEPTH_BUCKETS) == bucket) & (dmax > 0)).astype(jnp.int32),
     )
 
 
@@ -148,11 +173,16 @@ def combine_sums(a: dict[str, np.ndarray], b: dict[str, np.ndarray]) -> dict[str
     """Merge two run_batch outputs over disjoint run sets: additive for the
     stat sums, elementwise max for the ``*_max`` telemetry keys (a batch's
     busy-chunk count / deepest reorg is the max over its runs, and run
-    behavior is batching-invariant under the counter-based RNG)."""
-    return {
-        k: np.maximum(a[k], b[k]) if k.endswith(_MAX_KEYS_SUFFIX) else a[k] + b[k]
-        for k in a
-    }
+    behavior is batching-invariant under the counter-based RNG), and
+    run-axis concatenation for the per-run flight-recorder arrays."""
+    def merge(k):
+        if k.startswith("flight_"):
+            return np.concatenate([np.asarray(a[k]), np.asarray(b[k])])
+        if k.endswith(_MAX_KEYS_SUFFIX):
+            return np.maximum(a[k], b[k])
+        return a[k] + b[k]
+
+    return {k: merge(k) for k in a}
 
 
 def _host_reduce_telemetry(out: dict[str, np.ndarray], busy_chunks: int) -> None:
@@ -165,6 +195,12 @@ def _host_reduce_telemetry(out: dict[str, np.ndarray], busy_chunks: int) -> None
     )
     out["tele_active_steps_sum"] = np.int64(
         out.pop("tele_active_steps_per_run").astype(np.int64).sum()
+    )
+    out["tele_stale_by_miner_sum"] = (
+        out.pop("tele_stale_by_miner_per_run").astype(np.int64).sum(axis=0)
+    )
+    out["tele_reorg_depth_hist_sum"] = (
+        out.pop("tele_reorg_depth_hist_per_run").astype(np.int64).sum(axis=0)
     )
     out["tele_chunks_max"] = np.int64(busy_chunks)
 
@@ -191,8 +227,8 @@ def default_n_steps(duration_ms: int, block_interval_s: float) -> int:
 
 def _step_event(
     state: SimState, w: jax.Array, dt: jax.Array, params: SimParams, cap: jax.Array,
-    any_selfish: bool,
-) -> SimState:
+    any_selfish: bool, fr=None,
+):
     """One event given this step's (winner, interval) draws: a block find if
     one is due at ``t``, then the notify sweep, then cut-through time advance.
     ``cap`` freezes the run when it passes its chunk-relative end (duration
@@ -202,6 +238,11 @@ def _step_event(
     selects: a winner index of -1 makes ``found_block`` an exact identity, and
     ``notify(do=...)`` gates its flush/reveal/adopt masks — so every state
     leaf is computed and written once per step.
+
+    ``fr`` (a :class:`tpusim.flight.FlightRecorder`, or None when recording
+    is compiled out) folds this event into the flight ring. It is threaded
+    as the second return value either way — None is an empty pytree, so the
+    recorder-less program is unchanged by the uniform arity.
     """
     active = state.t < cap
     found_due = active & (state.t == state.next_block_time)
@@ -220,22 +261,31 @@ def _step_event(
     # time in place when a same-ms find is still pending (unflushed arrivals
     # could otherwise pull the min below cur_time).
     new_t = jnp.maximum(jnp.minimum(state2.next_block_time, earliest_arrival(state2)), state2.t)
-    return state2._replace(t=jnp.where(active, new_t, state.t))
+    out = state2._replace(t=jnp.where(active, new_t, state.t))
+    if fr is not None:
+        from .flight import record_step
+
+        fr = record_step(
+            fr, old=state, found=state1, new=out, w=w, found_due=found_due,
+            do=do_notify,
+        )
+    return out, fr
 
 
 def _step(
-    state: SimState, bits2: jax.Array, params: SimParams, cap: jax.Array, any_selfish: bool
-) -> SimState:
+    state: SimState, bits2: jax.Array, params: SimParams, cap: jax.Array,
+    any_selfish: bool, fr=None,
+):
     """Threefry step: one (winner, interval) uint32 word pair is burned per
     scan step whether or not a find is due — that is what makes the draws
     counter-based and order-independent (module docstring)."""
     w = winner_from_bits(bits2[0], params.thresholds)
     dt = interval_from_bits(bits2[1], params.mean_interval_ms)
-    return _step_event(state, w, dt, params, cap, any_selfish)
+    return _step_event(state, w, dt, params, cap, any_selfish, fr=fr)
 
 
 def _step_xoro(state: SimState, xi, xw, params: SimParams, cap: jax.Array,
-               any_selfish: bool):
+               any_selfish: bool, fr=None):
     """xoroshiro128++ step: two sequential per-run streams (interval, winner)
     advanced ONLY when the draw is consumed (a find is due this step), exactly
     mirroring the native backend's consumption pattern
@@ -256,7 +306,8 @@ def _step_xoro(state: SimState, xi, xw, params: SimParams, cap: jax.Array,
     dt = interval_ms_from_word(ih, il, params.mean_interval_ms, float(INTERVAL_CAP))
     xi = select_streams(found_due, xi2, xi)
     xw = select_streams(found_due, xw2, xw)
-    return _step_event(state, w, dt, params, cap, any_selfish), xi, xw
+    state2, fr = _step_event(state, w, dt, params, cap, any_selfish, fr=fr)
+    return state2, xi, xw, fr
 
 
 # Design note (negative result, kept so it is not re-attempted): stepping one
@@ -338,6 +389,13 @@ class Engine:
         )
         any_selfish = self.any_selfish
         K = self.superstep
+        # Flight recorder (tpusim.flight): a trace-time constant. 0 means the
+        # recorder leaves are never created and no recording op is traced —
+        # the jitted programs are identical to a recorder-less build (pinned
+        # by tests/test_flight.py).
+        self.flight_capacity = fcap = config.flight_capacity
+        if fcap:
+            from . import flight as _flight
 
         xoro = config.rng == "xoroshiro"
 
@@ -354,41 +412,51 @@ class Engine:
                 nbt = interval_ms_from_word(
                     ih, il, params.mean_interval_ms, float(INTERVAL_CAP)
                 )
-                return state._replace(next_block_time=nbt), (init_counters(), xi, xw)
+                # The recorder slot is always present; None is an empty
+                # pytree, so the fcap=0 aux (and every program carrying it)
+                # is unchanged by the uniform arity.
+                fr = _flight.init_recorder(fcap) if fcap else None
+                return state._replace(next_block_time=nbt), (init_counters(m), xi, xw, fr)
 
             def chunk_fn(
                 state: SimState, aux, cap: jax.Array, run_key: jax.Array,
                 chunk_idx: jax.Array, params: SimParams,
             ):
-                ctr, xi, xw = aux
+                ctr, xi, xw, fr = aux
 
                 def body(carry, _):
-                    st, xi, xw, ctr = carry
+                    st, xi, xw, ctr, fr = carry
                     for _j in range(K):
                         prev = st
-                        st, xi, xw = _step_xoro(st, xi, xw, params, cap, any_selfish)
+                        st, xi, xw, fr = _step_xoro(
+                            st, xi, xw, params, cap, any_selfish, fr
+                        )
                         ctr = _count_step(ctr, prev, st, cap)
-                    return (st, xi, xw, ctr), None
+                    return (st, xi, xw, ctr, fr), None
 
-                (state, xi, xw, ctr), _ = jax.lax.scan(
-                    body, (state, xi, xw, ctr), None, length=steps // K
+                (state, xi, xw, ctr, fr), _ = jax.lax.scan(
+                    body, (state, xi, xw, ctr, fr), None, length=steps // K
                 )
                 state, elapsed = rebase(state)
-                return state, (ctr, xi, xw), elapsed
+                if fr is not None:
+                    fr = _flight.advance_base(fr, elapsed)
+                return state, (ctr, xi, xw, fr), elapsed
         else:
 
             def init_fn(run_key: jax.Array, params: SimParams):
                 state = init_state(m, k, exact)
                 bits = jax.random.bits(jax.random.fold_in(run_key, 0), (2,), jnp.uint32)
+                # None recorder slot = empty pytree: see the xoroshiro twin.
+                fr = _flight.init_recorder(fcap) if fcap else None
                 return state._replace(
                     next_block_time=interval_from_bits(bits[1], params.mean_interval_ms)
-                ), (init_counters(),)
+                ), (init_counters(m), fr)
 
             def chunk_fn(
                 state: SimState, aux, cap: jax.Array, run_key: jax.Array,
                 chunk_idx: jax.Array, params: SimParams,
             ):
-                (ctr,) = aux
+                ctr, fr = aux
                 key = jax.random.fold_in(run_key, 1 + chunk_idx)
                 # The (steps, 2) word block reshaped to (steps/K, K, 2): scan
                 # step s row j is word pair s*K + j — the same per-event
@@ -397,16 +465,18 @@ class Engine:
                 bits = bits.reshape(steps // K, K, 2)
 
                 def body(carry, xs: jax.Array):
-                    st, ctr = carry
+                    st, ctr, fr = carry
                     for j in range(K):
                         prev = st
-                        st = _step(st, xs[j], params, cap, any_selfish)
+                        st, fr = _step(st, xs[j], params, cap, any_selfish, fr)
                         ctr = _count_step(ctr, prev, st, cap)
-                    return (st, ctr), None
+                    return (st, ctr, fr), None
 
-                (state, ctr), _ = jax.lax.scan(body, (state, ctr), bits)
+                (state, ctr, fr), _ = jax.lax.scan(body, (state, ctr, fr), bits)
                 state, elapsed = rebase(state)
-                return state, (ctr,), elapsed
+                if fr is not None:
+                    fr = _flight.advance_base(fr, elapsed)
+                return state, (ctr, fr), elapsed
 
         def finalize_fn(state: SimState, t_end: jax.Array) -> dict[str, jax.Array]:
             per_run = jax.vmap(final_stats)(state, t_end)
@@ -517,14 +587,19 @@ class Engine:
                     "tele_reorg_depth_per_run": P("runs"),
                     "tele_stale_events_per_run": P("runs"),
                     "tele_active_steps_per_run": P("runs"),
+                    "tele_stale_by_miner_per_run": P("runs"),
+                    "tele_reorg_depth_hist_per_run": P("runs"),
                     "n_chunks": P(), "unfinished": P(),
                 }
+                if self.flight_capacity:
+                    loop_out_specs["flight_buf"] = P("runs")
+                    loop_out_specs["flight_count"] = P("runs")
 
                 def sharded_device_loop(keys, hi0, lo0, params):
                     sums = self._device_loop(keys, hi0, lo0, params)
                     out = {}
                     for name, v in sums.items():
-                        if name.endswith("_per_run"):
+                        if name.endswith("_per_run") or name.startswith("flight_"):
                             out[name] = v
                         elif name == "n_chunks":
                             out[name] = jax.lax.pmax(v, "runs")
@@ -561,6 +636,43 @@ class Engine:
                     ),
                     donate_argnums=(0, 1, 2, 3),
                 )
+
+    def reuse_key(self) -> tuple:
+        """Hashable identity of every value BAKED into this engine's jitted
+        programs — two configs with equal keys compile to the same programs,
+        so one Engine can serve both (the roster percentages, propagation
+        delays and seed are runtime inputs via ``params``/``keys`` and stay
+        out of the key). Used by the sweep driver's engine cache
+        (tpusim.runner.make_engine) to stop same-shape grid points from
+        recompiling per point."""
+        c = self.config
+        mesh_id = None
+        if self.mesh is not None:
+            # Topology identity: shard-mapped programs bake the mesh's axis
+            # layout and device set.
+            mesh_id = (
+                self.mesh.axis_names, self.mesh.devices.shape,
+                tuple(d.id for d in self.mesh.devices.flat),
+            )
+        return (
+            type(self).__name__, self.n_miners, c.resolved_group_slots,
+            self.exact, self.any_selfish, self.chunk_steps, self.superstep,
+            self.max_chunks, c.rng, c.flight_capacity, mesh_id,
+        )
+
+    def rebind(self, config: SimConfig, key: tuple) -> "Engine":
+        """Point this engine at another config whose freshly-constructed
+        engine produced :meth:`reuse_key` ``key`` (the cache caller builds
+        that candidate anyway — construction is cheap, compilation is not):
+        only the runtime inputs — roster params, seed, duration ledger —
+        change, so every compiled program stays valid and warm."""
+        if key != self.reuse_key():
+            raise ValueError(
+                f"rebind across engine shapes: {key} != {self.reuse_key()}"
+            )
+        self.config = config
+        self.params = make_params(config)
+        return self
 
     def make_keys(self, start: int, count: int) -> jax.Array:
         """The per-run sampling-identity array for global run indices
@@ -629,13 +741,25 @@ class Engine:
         # Per-run telemetry counters out of the carried aux; reduced on the
         # host like the ratio leaves (_host_reduce_telemetry) — an int32
         # device sum of active_steps would overflow on large batches.
+        self._aux_to_sums(aux, sums)
+        sums["n_chunks"] = i
+        sums["unfinished"] = jnp.any((hi > 0) | (lo > 0))
+        return sums
+
+    def _aux_to_sums(self, aux, sums: dict) -> None:
+        """Spill the carried aux (counters and, when recording, the flight
+        ring) into per-run output leaves — the one place the aux layout is
+        decoded, shared by all three dispatch paths."""
         ctr: SimCounters = aux[0]
         sums["tele_reorg_depth_per_run"] = ctr.reorg_max
         sums["tele_stale_events_per_run"] = ctr.stale_events
         sums["tele_active_steps_per_run"] = ctr.active_steps
-        sums["n_chunks"] = i
-        sums["unfinished"] = jnp.any((hi > 0) | (lo > 0))
-        return sums
+        sums["tele_stale_by_miner_per_run"] = ctr.stale_by_miner
+        sums["tele_reorg_depth_hist_per_run"] = ctr.reorg_depth_hist
+        if self.flight_capacity:
+            fr = aux[-1]
+            sums["flight_buf"] = fr.buf
+            sums["flight_count"] = fr.count
 
     def _ledger_chunk(self, state, aux, hi, lo, keys, chunk_idx, params):
         """One chunk of :meth:`_device_loop`'s body as a standalone jitted
@@ -707,10 +831,12 @@ class Engine:
         # tpusim-lint: disable=JX002 -- batch-end stat transfer, once per
         # batch, after the dispatch loop has fully drained.
         out = _host_reduce_sums({k: np.asarray(v) for k, v in sums.items()})
-        ctr: SimCounters = aux[0]
-        out["tele_reorg_depth_per_run"] = np.asarray(ctr.reorg_max)
-        out["tele_stale_events_per_run"] = np.asarray(ctr.stale_events)
-        out["tele_active_steps_per_run"] = np.asarray(ctr.active_steps)
+        dev_sums: dict = {}
+        self._aux_to_sums(aux, dev_sums)
+        # tpusim-lint: disable=JX002 -- same batch-end transfer as above: the
+        # aux counters (and flight ring, if recording) come down once per
+        # batch, after the dispatch loop has fully drained.
+        out.update({k: np.asarray(v) for k, v in dev_sums.items()})
         _host_reduce_telemetry(out, popped)
         out["runs"] = np.int64(n)
         return out
@@ -864,16 +990,23 @@ class Engine:
         if multiproc:
             # Non-addressable shards: telemetry reduces over this process's
             # local runs only (the stat sums above are still global psums).
+            # Run-axis concatenation, not ravel: the histogram counter leaves
+            # are (runs, M)/(runs, B) shaped.
             # tpusim-lint: disable=JX002 -- once per batch, after the loop.
             fetch = lambda arr: np.concatenate(
-                [np.asarray(s.data).ravel() for s in arr.addressable_shards]
+                [np.asarray(s.data) for s in arr.addressable_shards], axis=0
             )
         else:
             fetch = np.asarray
-        ctr: SimCounters = aux[0]
-        out["tele_reorg_depth_per_run"] = fetch(ctr.reorg_max)
-        out["tele_stale_events_per_run"] = fetch(ctr.stale_events)
-        out["tele_active_steps_per_run"] = fetch(ctr.active_steps)
+        dev_sums: dict = {}
+        self._aux_to_sums(aux, dev_sums)
+        if multiproc:
+            # Shard order is not run order, so per-run flight rows cannot be
+            # attributed to global run indices here; recording stays a
+            # single-controller affair (the trace CLI never shards).
+            dev_sums.pop("flight_buf", None)
+            dev_sums.pop("flight_count", None)
+        out.update({k: fetch(v) for k, v in dev_sums.items()})
         # Every executed chunk had >= 1 active run (the loop breaks the
         # moment all_done flips), so chunk_idx + 1 IS the busy-chunk count.
         _host_reduce_telemetry(out, chunk_idx + 1)

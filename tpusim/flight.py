@@ -1,0 +1,187 @@
+"""Device-side event flight recorder: a fixed-capacity ring buffer of packed
+per-event rows, carried with the simulation state.
+
+The PR-2 counters (:class:`tpusim.engine.SimCounters`) are scalar reductions —
+when a sweep point disagrees with the native C++ reference they say *how much*
+diverged, never *which events*. The flight recorder closes that gap: with
+``SimConfig.flight_capacity > 0`` every simulation event writes one packed
+int32 row into a per-run ring buffer that rides the same HBM round trip as the
+state tree (a :class:`FlightRecorder` leaf in the scan engine's carried aux,
+three extra VMEM-resident leaves in the Pallas kernel), and the host decodes
+it into a Chrome-trace/Perfetto timeline or a JSONL event log
+(:mod:`tpusim.flight_export`). With the default ``flight_capacity = 0`` the
+recorder does not exist: no leaves are created, no ops are traced, the jitted
+programs are byte-identical to a recorder-less build (pinned by
+tests/test_flight.py).
+
+Row layout (``N_FIELDS`` int32 words): ``kind, miner, height, depth, t_hi,
+t_lo``. Event time is absolute simulation milliseconds as a base-2^30 int32
+limb pair (``t_hi * 2^30 + t_lo``; the engine re-bases every run's int32 clock
+per chunk, so the recorder carries each run's absolute chunk origin in the
+same limb form and the host reassembles int64 times at decode).
+
+Event kinds, classified exactly like the reference event loop
+(main.cpp:128-192) iterations:
+
+  * ``find``    — a block find was due this step; ``miner`` is the winner,
+    ``height`` its chain length including the new block (private included).
+  * ``arrival`` — no find was due and the notify sweep flushed >= 1 pending
+    propagation group; ``miner`` owns the earliest flushed arrival (lowest
+    index on ties), ``height`` is that miner's post-sweep chain length. A
+    flush folded into a same-millisecond find step records as the find alone,
+    matching the reference's single loop iteration for that instant.
+  * ``stale`` / ``reorg`` — the sweep made >= 1 miner adopt the best chain;
+    ``stale`` when the adoptions popped own blocks (``depth`` = the max pops
+    by a single adopter — the same quantity SimCounters.reorg_max tracks),
+    plain ``reorg`` when no block was lost. ``miner`` is the adopter with the
+    deepest pop (lowest index on ties), ``height`` the adopted best height.
+
+A step can record two rows (its find-or-arrival row, then its adoption row),
+so trace-event counts tie out exactly against the scalar counters:
+``#stale rows == tele_stale_events_sum`` and the per-depth tally of stale
+rows equals ``tele_reorg_depth_hist_sum`` (pinned by tests).
+
+Overflow: the ring keeps the NEWEST ``capacity`` rows; ``count`` keeps the
+true event total, so the host reports ``dropped = max(0, count - capacity)``
+explicitly instead of silently truncating.
+
+The scan-layout implementation lives here; the Pallas kernel re-expresses the
+same masks and operands runs-last inside :mod:`tpusim.pallas_engine`, and the
+two are pinned bit-equal like every other engine output.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .state import INF_TIME, SimState
+
+__all__ = [
+    "FlightRecorder", "init_recorder", "record_step", "advance_base",
+    "KIND_FIND", "KIND_ARRIVAL", "KIND_STALE", "KIND_REORG", "KIND_NAMES",
+    "N_FIELDS", "FLIGHT_TIME_BASE",
+]
+
+I32 = jnp.int32
+
+KIND_FIND = 0
+KIND_ARRIVAL = 1
+KIND_STALE = 2
+KIND_REORG = 3
+KIND_NAMES = ("find", "arrival", "stale", "reorg")
+
+#: Row words: kind, miner, height, depth, t_hi, t_lo.
+N_FIELDS = 6
+FIELD_KIND, FIELD_MINER, FIELD_HEIGHT, FIELD_DEPTH, FIELD_T_HI, FIELD_T_LO = range(6)
+
+#: Base of the absolute-time int32 limb pair (t_hi * 2^30 + t_lo). Matches
+#: the engine's remaining-time ledger base: one chunk's elapsed is < 2^30, so
+#: per-chunk accumulation carries at most one limb (engine._LEDGER_BASE).
+FLIGHT_TIME_BASE = 1 << 30
+
+
+class FlightRecorder(NamedTuple):
+    """Per-run recorder state (one element of the vmapped batch)."""
+
+    #: int32 [capacity, N_FIELDS] ring of packed event rows; row ``e`` of the
+    #: run's event sequence lives at slot ``e % capacity``.
+    buf: jax.Array
+    #: int32 [] events recorded since the run started, overwritten included —
+    #: the host derives the dropped count from it.
+    count: jax.Array
+    #: int32 [] absolute time of the current chunk origin, high limb.
+    base_hi: jax.Array
+    #: int32 [] low limb (< 2^30).
+    base_lo: jax.Array
+
+
+def init_recorder(capacity: int) -> FlightRecorder:
+    z = jnp.zeros((), I32)
+    return FlightRecorder(jnp.zeros((capacity, N_FIELDS), I32), z, z, z)
+
+
+def _push_row(
+    fr: FlightRecorder,
+    rec: jax.Array,
+    kind: jax.Array,
+    miner: jax.Array,
+    height: jax.Array,
+    depth: jax.Array,
+    t: jax.Array,
+) -> FlightRecorder:
+    """Write one row at slot ``count % capacity`` where ``rec`` is set; the
+    slot select is one-hot arithmetic (no dynamic indexing on TPU). The row's
+    time fields are the UN-normalized limb pair (base_hi, base_lo + t): the
+    low word can exceed 2^30 by up to one chunk span, and the host's int64
+    reassembly absorbs it — no device-side carry per event."""
+    capacity = fr.buf.shape[0]
+    slot = jax.lax.rem(fr.count, jnp.int32(capacity))
+    onehot = jnp.arange(capacity) == slot
+    row = jnp.stack(
+        [kind, miner, height, depth, fr.base_hi, fr.base_lo + t]
+    ).astype(I32)
+    buf = jnp.where((rec & onehot)[:, None], row[None, :], fr.buf)
+    return fr._replace(buf=buf, count=fr.count + rec.astype(I32))
+
+
+def record_step(
+    fr: FlightRecorder,
+    *,
+    old: SimState,
+    found: SimState,
+    new: SimState,
+    w: jax.Array,
+    found_due: jax.Array,
+    do: jax.Array,
+) -> FlightRecorder:
+    """Fold one engine step into the ring: ``old`` is the step-entry state,
+    ``found`` the post-find (pre-notify) state, ``new`` the step-exit state;
+    ``w`` the raw winner draw (valid where ``found_due``), ``do`` the notify
+    gate. Up to two rows: find-or-arrival, then stale-or-reorg."""
+    m = old.height.shape[0]
+    midx = jnp.arange(m)
+    t = old.t
+
+    # Row 1 — the time event of this step (reference loop iteration kind).
+    # Arrival detection uses the step-entry groups: the sweep's flush gate is
+    # exactly ``do`` with flush time ``t``, and for a no-find step the
+    # post-find groups are the entry groups (found_block is an identity).
+    pend = jnp.where(old.group_arrival <= t, old.group_arrival, INF_TIME)
+    pmin_per = jnp.min(pend, axis=-1)  # [M] earliest arrived per miner
+    pmin = jnp.min(pmin_per)
+    flushed = do & (pmin < INF_TIME)
+    arr_miner = jnp.min(jnp.where(pmin_per == pmin, midx, m))
+    rec1 = found_due | flushed
+    kind1 = jnp.where(found_due, KIND_FIND, KIND_ARRIVAL)
+    miner1 = jnp.where(found_due, w, arr_miner)
+    h_src = jnp.where(found_due, found.height, new.height)
+    height1 = jnp.sum(jnp.where(midx == miner1, h_src, 0), dtype=I32)
+    fr = _push_row(fr, rec1, kind1, miner1, height1, jnp.int32(0), t)
+
+    # Row 2 — the sweep's adoption outcome. Adoption is the only height
+    # change notify makes, so the found->new delta identifies adopters; the
+    # stale delta is the per-adopter own-block pop count (the operands of
+    # engine._count_step).
+    adopt = new.height > found.height
+    d_stale = new.stale - found.stale
+    dmax = jnp.max(d_stale)
+    rec2 = jnp.any(adopt)
+    kind2 = jnp.where(dmax > 0, KIND_STALE, KIND_REORG)
+    score = jnp.where(adopt, d_stale, -1)
+    miner2 = jnp.min(jnp.where(adopt & (score == jnp.max(score)), midx, m))
+    height2 = jnp.sum(jnp.where(midx == miner2, new.height, 0), dtype=I32)
+    return _push_row(fr, rec2, kind2, miner2, height2, dmax, t)
+
+
+def advance_base(fr: FlightRecorder, elapsed: jax.Array) -> FlightRecorder:
+    """Advance the absolute chunk origin by a re-base's ``elapsed`` (one limb
+    carry suffices: elapsed < 2^30 and base_lo < 2^30)."""
+    lo = fr.base_lo + elapsed
+    carry = lo >= FLIGHT_TIME_BASE
+    return fr._replace(
+        base_hi=fr.base_hi + carry.astype(I32),
+        base_lo=lo - jnp.where(carry, jnp.int32(FLIGHT_TIME_BASE), 0),
+    )

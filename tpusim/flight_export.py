@@ -1,0 +1,280 @@
+"""Host-side decode and export of the device flight recorder (``tpusim
+trace``).
+
+Takes the per-run ring buffers a flight-enabled ``run_batch`` returns
+(``flight_buf`` int32 [runs, capacity, N_FIELDS], ``flight_count`` int32
+[runs] — see :mod:`tpusim.flight` for the row semantics) and turns them into:
+
+  * a **JSONL event log** — one line per event, sorted by (run, seq), with
+    stable keys ``{"run", "seq", "kind", "t_ms", "miner", "height",
+    "depth"}`` — the cross-backend oracle format: the native C++ backend's
+    event sequence for the same seed (``rng="xoroshiro"`` draws
+    bit-compatibly with it) diffs line-by-line against this file;
+  * a **Chrome-trace / Perfetto JSON** timeline — one process per run, one
+    track (thread) per miner, instant events stamped at absolute simulation
+    milliseconds — loadable in ``ui.perfetto.dev`` or ``chrome://tracing``
+    and correlated to the ``--telemetry`` span ledger through the same
+    ``run_id`` recorded in ``otherData``.
+
+Ring overflow is explicit: ``count`` keeps the true event total, so runs
+whose event count exceeded the capacity report ``dropped = count -
+capacity`` (the ring keeps the NEWEST rows) instead of silently truncating.
+
+CLI::
+
+    python -m tpusim trace --runs 4 --days 2 --flight-capacity 1024 \
+        --trace-out artifacts/telemetry/sample.trace.json \
+        --events-out /tmp/events.jsonl
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from .flight import FLIGHT_TIME_BASE, KIND_NAMES, N_FIELDS
+
+__all__ = [
+    "FlightLog", "decode_flight", "events_jsonl", "perfetto_trace",
+    "validate_perfetto", "main",
+]
+
+
+@dataclasses.dataclass
+class FlightLog:
+    """Decoded flight events of one or more batches."""
+
+    #: One dict per surviving event, sorted by (run, seq): run (global run
+    #: index), seq (event number within the run, 0-based over ALL events
+    #: including dropped ones), kind, t_ms (absolute simulation ms, int),
+    #: miner, height, depth.
+    events: list[dict]
+    #: global run index -> rows lost to ring overflow (0 entries omitted).
+    dropped: dict[int, int]
+    capacity: int
+
+    def extend(self, other: "FlightLog") -> None:
+        self.events.extend(other.events)
+        self.dropped.update(other.dropped)
+
+
+def decode_flight(sums: dict[str, Any], *, start: int = 0) -> FlightLog:
+    """Decode one ``run_batch`` output; ``start`` is the batch's first global
+    run index (the recorder never stores run ids — the vmapped position plus
+    the batch offset IS the identity, same convention as ``make_run_keys``)."""
+    buf = np.asarray(sums["flight_buf"])
+    cnt = np.asarray(sums["flight_count"])
+    runs, capacity, fields = buf.shape
+    if fields != N_FIELDS:
+        raise ValueError(f"flight_buf has {fields} fields, expected {N_FIELDS}")
+    events: list[dict] = []
+    dropped: dict[int, int] = {}
+    for r in range(runs):
+        n = int(cnt[r])
+        if n > capacity:
+            dropped[start + r] = n - capacity
+        # Surviving events are the newest min(n, capacity): sequence numbers
+        # [n - kept, n); event e sits at ring slot e % capacity.
+        for e in range(n - min(n, capacity), n):
+            row = buf[r, e % capacity]
+            events.append({
+                "run": start + r,
+                "seq": e,
+                "kind": KIND_NAMES[int(row[0])],
+                "t_ms": int(row[4]) * FLIGHT_TIME_BASE + int(row[5]),
+                "miner": int(row[1]),
+                "height": int(row[2]),
+                "depth": int(row[3]),
+            })
+    return FlightLog(events=events, dropped=dropped, capacity=capacity)
+
+
+def events_jsonl(events: list[dict]) -> str:
+    """The diffable event-log text: one JSON object per line, key order
+    fixed by the event dicts (insertion order), sorted by (run, seq)."""
+    ordered = sorted(events, key=lambda e: (e["run"], e["seq"]))
+    return "".join(json.dumps(e) + "\n" for e in ordered)
+
+
+def perfetto_trace(
+    events: list[dict],
+    *,
+    n_miners: int,
+    run_id: str | None = None,
+    meta: dict[str, Any] | None = None,
+) -> dict:
+    """Chrome-trace JSON: pid = run, tid = miner track, instant events at
+    absolute sim time (``ts`` is microseconds per the trace-event spec, so
+    1 trace second renders as 1 simulated millisecond x 1000)."""
+    tev: list[dict] = []
+    runs = sorted({e["run"] for e in events})
+    for r in runs:
+        tev.append({
+            "ph": "M", "name": "process_name", "pid": r,
+            "args": {"name": f"run {r}"},
+        })
+        for m in range(n_miners):
+            tev.append({
+                "ph": "M", "name": "thread_name", "pid": r, "tid": m,
+                "args": {"name": f"miner {m}"},
+            })
+    for e in sorted(events, key=lambda e: (e["run"], e["seq"])):
+        tev.append({
+            "name": e["kind"],
+            "ph": "i",
+            "s": "t",  # thread-scoped instant: one tick on the miner's track
+            "ts": e["t_ms"] * 1000,
+            "pid": e["run"],
+            "tid": e["miner"],
+            "args": {"seq": e["seq"], "height": e["height"], "depth": e["depth"]},
+        })
+    other: dict[str, Any] = {"tool": "tpusim trace"}
+    if run_id is not None:
+        other["run_id"] = run_id
+    if meta:
+        other.update(meta)
+    return {"traceEvents": tev, "displayTimeUnit": "ms", "otherData": other}
+
+
+def validate_perfetto(trace: Any) -> int:
+    """Schema check for the exported trace (used by CI's smoke leg and the
+    tests): raises ValueError on any violation, returns the number of
+    non-metadata events."""
+    if not isinstance(trace, dict) or not isinstance(trace.get("traceEvents"), list):
+        raise ValueError("trace must be a dict with a traceEvents list")
+    n = 0
+    for ev in trace["traceEvents"]:
+        if not isinstance(ev, dict) or "ph" not in ev:
+            raise ValueError(f"trace event without ph: {ev!r}")
+        if ev["ph"] == "M":
+            if "name" not in ev:
+                raise ValueError(f"metadata event without name: {ev!r}")
+            continue
+        if ev["ph"] not in ("i", "I", "X"):
+            raise ValueError(f"unexpected phase {ev['ph']!r}")
+        if not isinstance(ev.get("ts"), (int, float)) or ev["ts"] < 0:
+            raise ValueError(f"event without numeric ts: {ev!r}")
+        if not isinstance(ev.get("pid"), int) or not isinstance(ev.get("tid"), int):
+            raise ValueError(f"event without integer pid/tid: {ev!r}")
+        if ev["ph"] == "i" and ev.get("s") not in ("t", "p", "g"):
+            raise ValueError(f"instant event without scope: {ev!r}")
+        if not isinstance(ev.get("name"), str):
+            raise ValueError(f"event without name: {ev!r}")
+        n += 1
+    return n
+
+
+def main(argv: list[str] | None = None) -> int:
+    """``tpusim trace``: run a (small) simulation with the flight recorder on
+    and export the ring as Perfetto JSON + optional JSONL event log. Accepts
+    every run flag of ``tpusim`` (config file, roster flags, --engine, ...);
+    runs unsharded on purpose — event traces are a debugging tool for runs
+    small enough to read, and per-run identity must stay trivially stable."""
+    from .cli import build_parser, config_from_args
+
+    p = build_parser()
+    p.prog = "tpusim trace"
+    p.description = "Run with the event flight recorder on and export the timeline."
+    p.add_argument(
+        "--flight-capacity", type=int, default=None,
+        help="per-run ring rows to keep (newest win; dropped counts "
+        "reported); default: the config file's flight_capacity, else 1024",
+    )
+    p.add_argument(
+        "--trace-out", type=Path, default=Path("flight.trace.json"),
+        help="Perfetto / chrome-trace JSON output (load in ui.perfetto.dev)",
+    )
+    p.add_argument(
+        "--events-out", type=Path, default=None,
+        help="also write the JSONL event log here (cross-backend diffable)",
+    )
+    args = p.parse_args(argv)
+    if args.backend == "cpp":
+        raise SystemExit(
+            "error: tpusim trace records on the JAX engines; the cpp backend "
+            "is the DIFF TARGET — produce its event log separately and diff "
+            "against --events-out"
+        )
+    if args.flight_capacity is not None and args.flight_capacity < 1:
+        raise SystemExit("error: --flight-capacity must be >= 1 for tracing")
+    try:
+        config = config_from_args(args)
+    except ValueError as e:
+        raise SystemExit(f"error: {e}") from None
+    # Flag wins over config file, config file over the 1024 default — a
+    # config that sized its own ring must not be clobbered by the default.
+    capacity = args.flight_capacity or config.flight_capacity or 1024
+    config = dataclasses.replace(config, flight_capacity=capacity)
+
+    from .runner import make_engine
+    from .telemetry import new_run_id
+
+    run_id = new_run_id()
+    prefer = None if args.engine == "auto" else (args.engine == "pallas")
+    eng = make_engine(
+        config, None, prefer_pallas=prefer,
+        tile_runs=args.tile_runs, step_block=args.step_block,
+    )
+    log = FlightLog(events=[], dropped={}, capacity=capacity)
+    tele_totals: dict[str, int] = {"stale_events": 0}
+    done = 0
+    while done < config.runs:
+        n = min(config.batch_size, config.runs - done)
+        out = eng.run_batch(eng.make_keys(done, n))
+        log.extend(decode_flight(out, start=done))
+        tele_totals["stale_events"] += int(out["tele_stale_events_sum"])
+        done += n
+
+    # Sort once; the exporters' own (run, seq) sorts are then O(n) no-ops.
+    log.events.sort(key=lambda e: (e["run"], e["seq"]))
+    m = config.network.n_miners
+    trace = perfetto_trace(
+        log.events, n_miners=m, run_id=run_id,
+        meta={
+            "config": json.loads(config.to_json()),
+            "dropped": {str(k): v for k, v in sorted(log.dropped.items())},
+        },
+    )
+    validate_perfetto(trace)
+    args.trace_out.parent.mkdir(parents=True, exist_ok=True)
+    args.trace_out.write_text(json.dumps(trace))
+    if args.events_out is not None:
+        args.events_out.parent.mkdir(parents=True, exist_ok=True)
+        args.events_out.write_text(events_jsonl(log.events))
+    if args.telemetry:
+        # Correlate with the span ledger: the trace span carries the SAME
+        # run_id as the exported file's otherData.
+        from .telemetry import TelemetryRecorder
+
+        rec = TelemetryRecorder(args.telemetry, run_id=run_id)
+        rec.emit(
+            "trace", runs=config.runs, events=len(log.events),
+            dropped=sum(log.dropped.values()), capacity=capacity,
+            trace_out=str(args.trace_out),
+        )
+        rec.close()
+
+    if not args.quiet:
+        stale_rows = sum(1 for e in log.events if e["kind"] == "stale")
+        print(
+            f"[trace] {len(log.events)} events from {config.runs} runs "
+            f"({len(log.dropped)} run(s) overflowed, "
+            f"{sum(log.dropped.values())} rows dropped; "
+            f"{stale_rows} stale rows vs counter {tele_totals['stale_events']}) "
+            f"-> {args.trace_out} (run_id {run_id}; open in ui.perfetto.dev)"
+        )
+        if log.dropped:
+            print(
+                "[trace] raise --flight-capacity above the per-run event "
+                "count to keep every event"
+            )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

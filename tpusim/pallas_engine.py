@@ -42,7 +42,16 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .config import SimConfig
-from .engine import Engine, SimCounters, combine_sums
+from .engine import DEPTH_BUCKETS, Engine, SimCounters, combine_sums
+from .flight import (
+    KIND_ARRIVAL,
+    KIND_FIND,
+    KIND_REORG,
+    KIND_STALE,
+    N_FIELDS,
+    FlightRecorder,
+    advance_base,
+)
 from .sampling import winner_thresholds32
 from .state import (
     INF_TIME,
@@ -79,11 +88,19 @@ _EXACT_LEAVES = (
 )
 #: Telemetry counter leaves (engine.SimCounters, runs-last), appended after
 #: the state leaves in the kernel's ref lists: per-run max single-reorg own
-#: pops, stale-event count, active steps. VMEM-resident like the state, so
-#: the per-event cost is one (M, R) reduction and no extra HBM traffic
-#: beyond 12 bytes per run per chunk. NOT part of _leaf_shapes: the roofline
-#: traffic model (profiling.state_bytes_per_run) counts simulation state.
-_TELE_LEAVES = ("mre", "sev", "act")
+#: pops, stale-event count, active steps, stale-events-by-miner, reorg-depth
+#: histogram. VMEM-resident like the state, so the per-event cost is one
+#: (M, R) reduction and no extra HBM traffic beyond ~(12 + 4*(M+8)) bytes per
+#: run per chunk. NOT part of _leaf_shapes: the roofline traffic model
+#: (profiling.state_bytes_per_run) counts simulation state.
+_TELE_LEAVES = ("mre", "sev", "act", "sbm", "rdh")
+
+#: Flight-recorder leaves (tpusim.flight), appended after the telemetry
+#: leaves when ``SimConfig.flight_capacity > 0``: the packed event ring
+#: (capacity, N_FIELDS, R), the event count (1, R) and the absolute-time
+#: chunk-origin limb pair (2, R). With the default capacity 0 they do not
+#: exist and the kernel is byte-identical to a recorder-less build.
+_FLIGHT_LEAVES = ("fbuf", "fcnt", "fbase")
 
 
 def _leaf_shapes(m: int, k: int, exact: bool) -> list[tuple[int, ...]]:
@@ -97,18 +114,22 @@ def _leaf_shapes(m: int, k: int, exact: bool) -> list[tuple[int, ...]]:
 
 def _make_kernel(
     *, exact: bool, any_selfish: bool, sb: int, mean_interval_ms: float,
-    n_state: int, superstep: int = 1
+    n_state: int, superstep: int = 1, flight_capacity: int = 0
 ):
     """Build the step-block kernel for one mode. Ref order: bits, cap, lo,
     hi, prop, selfish, then ``n_state`` input state refs (HBM-aliased to the
     outputs), then ``n_state`` output state refs (the live, VMEM-resident
     copies). ``superstep`` events are unrolled per fori_loop iteration —
     event e still reads bits row e, so draws (and results) are identical for
-    every width."""
+    every width. ``flight_capacity`` > 0 appends the event-recorder leaves
+    and the per-step ring writes (tpusim.flight row semantics, runs-last)."""
+    fcap = flight_capacity
 
     def kernel(bits_ref, cap_ref, lo_ref, hi_ref, prop_ref, selfish_ref, *state_refs):
         ins, outs = state_refs[:n_state], state_refs[n_state:]
         names = (_EXACT_LEAVES if exact else _FAST_LEAVES) + _TELE_LEAVES
+        if fcap:
+            names = names + _FLIGHT_LEAVES
 
         # First step block of this run tile: seed the VMEM-resident output
         # blocks from the inputs. They persist across the inner grid
@@ -196,6 +217,10 @@ def _make_kernel(
             t, nbt = st["t"], st["nbt"]
             height, stale, base = st["height"], st["stale"], st["base"]
             garr, gcnt, ovf = st["garr"], st["gcnt"], st["ovf"]
+            # Step-entry snapshots the flight rows need: the event time and
+            # the pre-push groups (arrival classification, tpusim.flight).
+            told = t
+            old_garr = st["garr"]
 
             bw = bits_ref[s, 0, :][None, :]  # (1, R) uint32
             bi = bits_ref[s, 1, :][None, :]
@@ -253,6 +278,7 @@ def _make_kernel(
                 garr, gcnt, over = push_groups(garr, gcnt, arrival, push_count, push_do)
             ovf = ovf + over
             height = height + owi
+            h_found = height  # post-find, pre-adopt chain lengths
             nbt = jnp.where(found_due, t + dt, nbt)
 
             # --- Notify sweep (flush + best + reveal + reorg), gated like
@@ -410,10 +436,75 @@ def _make_kernel(
             # Telemetry counters (engine.SimCounters semantics, bit-equal to
             # the scan engine's by construction: same masks, same operands).
             dmax = jnp.max(d_stale, axis=0, keepdims=True)  # (1, R)
+
+            if fcap:
+                # Flight recorder (tpusim.flight.record_step, runs-last): up
+                # to two ring rows per step — find-or-arrival, then
+                # stale-or-reorg — same masks and operands as the scan
+                # engine's recorder, so the buffers are pinned bit-equal.
+                fbuf, fcnt, fbase = st["fbuf"], st["fcnt"], st["fbase"]
+                b_hi, b_lo = fbase[0:1, :], fbase[1:2, :]
+                cidx = iot((fcap, 1, 1), 0)
+                fidx = iot((1, N_FIELDS, 1), 1)
+
+                def krow(kind, miner, hgt, depth):
+                    vals = (kind, miner, hgt, depth, b_hi, b_lo + told)
+                    row = vals[0].astype(I32)[:, None, :]
+                    for f in range(1, N_FIELDS):
+                        row = jnp.where(fidx == f, vals[f].astype(I32)[:, None, :], row)
+                    return row  # (1, F, R)
+
+                def kpush(fcnt, fbuf, rec, kind, miner, hgt, depth):
+                    slot = jax.lax.rem(fcnt, jnp.int32(fcap))  # (1, R)
+                    onehot = cidx == slot  # (C, 1, R)
+                    fbuf = jnp.where(onehot & rec, krow(kind, miner, hgt, depth), fbuf)
+                    return fcnt + rec.astype(I32), fbuf
+
+                if split2:
+                    a0o, a1o = old_garr
+                    pmin_per = jnp.minimum(
+                        jnp.where(a0o <= told, a0o, inf),
+                        jnp.where(a1o <= told, a1o, inf),
+                    )  # (M, R)
+                else:
+                    pmin_per = jnp.min(
+                        jnp.where(old_garr <= told, old_garr, inf), axis=1
+                    )
+                pmin = jnp.min(pmin_per, axis=0, keepdims=True)  # (1, R)
+                flushed = do & (pmin < inf)
+                arr_miner = jnp.min(
+                    jnp.where(pmin_per == pmin, midx, m), axis=0, keepdims=True
+                )
+                rec1 = found_due | flushed
+                kind1 = jnp.where(found_due, KIND_FIND, KIND_ARRIVAL)
+                w_idx = jnp.sum(midx * owi, axis=0, keepdims=True)  # (1, R)
+                miner1 = jnp.where(found_due, w_idx, arr_miner)
+                h1 = jnp.sum(
+                    jnp.where(midx == miner1, jnp.where(found_due, h_found, height), 0),
+                    axis=0, keepdims=True,
+                )
+                rec2 = jnp.any(adopt, axis=0, keepdims=True)
+                kind2 = jnp.where(dmax > 0, KIND_STALE, KIND_REORG)
+                score = jnp.where(adopt, d_stale, -1)
+                miner2 = jnp.min(
+                    jnp.where(adopt & (score == jnp.max(score, axis=0, keepdims=True)),
+                              midx, m),
+                    axis=0, keepdims=True,
+                )
+                h2 = jnp.sum(jnp.where(midx == miner2, height, 0), axis=0, keepdims=True)
+                fcnt, fbuf = kpush(fcnt, fbuf, rec1, kind1, miner1, h1,
+                                   jnp.zeros_like(dmax))
+                fcnt, fbuf = kpush(fcnt, fbuf, rec2, kind2, miner2, h2, dmax)
+                st.update(fbuf=fbuf, fcnt=fcnt)
+
             st.update(
                 mre=jnp.maximum(st["mre"], dmax),
                 sev=st["sev"] + (dmax > 0).astype(I32),
                 act=st["act"] + active.astype(I32),
+                sbm=st["sbm"] + (d_stale > 0).astype(I32),
+                rdh=st["rdh"]
+                + ((iot((DEPTH_BUCKETS, 1), 0) == jnp.minimum(dmax, DEPTH_BUCKETS) - 1)
+                   & (dmax > 0)).astype(I32),
             )
             st.update(t=t, nbt=nbt, height=height, stale=stale, base=base,
                       ovf=ovf, ocp=ocp, oin=oin, ocnt=ocnt)
@@ -521,6 +612,10 @@ class PallasEngine(Engine):
         exact = config.resolved_mode == "exact"
         state_words = sum(math.prod(s) for s in _leaf_shapes(m, k, exact))
         vmem_est = state_words * 4 * tile_runs * 10
+        # The flight ring is VMEM-resident storage plus one (C, F, tile) row
+        # select per recorded event — bulk, not contraction temporaries, so a
+        # x2 allowance instead of the state's x10.
+        vmem_est += config.flight_capacity * N_FIELDS * 4 * tile_runs * 2
         if vmem_est > 15_500_000 and not interpret and vmem_guard:
             raise ValueError(
                 f"estimated kernel VMEM footprint {vmem_est / 1e6:.1f} MB exceeds "
@@ -586,6 +681,34 @@ class PallasEngine(Engine):
             )
         self._chunk_impl = self._pallas_chunk
         self._scan_fallback: Engine | None = None
+
+    def reuse_key(self) -> tuple:
+        # The kernel BAKES what the scan engine takes as runtime params: the
+        # winner thresholds / propagation / selfish flags are captured
+        # constants of the jitted _pallas_chunk and the mean interval is a
+        # Python float inside the kernel body — so pallas reuse additionally
+        # requires the full roster, the interval, and the tiling knobs.
+        c = self.config
+        roster = tuple(
+            (mc.hashrate_pct, mc.propagation_ms, mc.selfish)
+            for mc in c.network.miners
+        )
+        return super().reuse_key() + (
+            roster, c.network.block_interval_s, self.tile_runs,
+            self.step_block, self.interpret,
+        )
+
+    def rebind(self, config: SimConfig, key: tuple) -> "PallasEngine":
+        super().rebind(config, key)
+        if self._scan_fallback is not None:
+            import dataclasses
+
+            twin_cfg = dataclasses.replace(config, chunk_steps=self.chunk_steps)
+            # Validate with a FRESH twin's key (construction is cheap): the
+            # pallas key subsumes every scan-baked value today, but the twin
+            # guard must not depend on that staying true.
+            self._scan_fallback.rebind(twin_cfg, Engine(twin_cfg).reuse_key())
+        return self
 
     def scan_twin(self) -> Engine:
         """A scan engine pinned to this engine's resolved chunk_steps — the
@@ -693,14 +816,26 @@ class PallasEngine(Engine):
         )(keys)
 
         st = self._state_to_kernel(state)
-        # Telemetry counters ride as three extra (1, R) kernel leaves after
-        # the state (engine.SimCounters order: reorg_max, stale_events,
-        # active_steps), aliased in-out like every state leaf.
-        (ctr,) = aux
+        # Telemetry counters ride as extra runs-last kernel leaves after the
+        # state (engine.SimCounters order: reorg_max, stale_events,
+        # active_steps, stale_by_miner, reorg_depth_hist), aliased in-out
+        # like every state leaf.
+        ctr = aux[0]
         st = st + (ctr.reorg_max[None, :], ctr.stale_events[None, :],
-                   ctr.active_steps[None, :])
+                   ctr.active_steps[None, :],
+                   jnp.moveaxis(ctr.stale_by_miner, 0, -1),
+                   jnp.moveaxis(ctr.reorg_depth_hist, 0, -1))
         shapes = [s + (n,) for s in _leaf_shapes(m, k, self.exact)]
-        shapes += [(1, n)] * 3
+        shapes += [(1, n)] * 3 + [(m, n), (DEPTH_BUCKETS, n)]
+        fcap = self.flight_capacity
+        if fcap:
+            # Flight-recorder leaves (tpusim.flight): ring, count, and the
+            # absolute-time chunk-origin limb pair (read-only in-kernel; the
+            # post-rebase advance below is the writer).
+            fr: FlightRecorder = aux[-1]
+            st = st + (jnp.moveaxis(fr.buf, 0, -1), fr.count[None, :],
+                       jnp.stack([fr.base_hi, fr.base_lo]))
+            shapes += [(fcap, N_FIELDS, n), (1, n), (2, n)]
 
         def tile_spec(shape):
             block = shape[:-1] + (tile,)
@@ -721,6 +856,7 @@ class PallasEngine(Engine):
             exact=self.exact, any_selfish=self.any_selfish, sb=sb,
             mean_interval_ms=float(self.params.mean_interval_ms),
             n_state=len(shapes), superstep=self.superstep,
+            flight_capacity=fcap,
         )
         grid = (n // tile, steps // sb)
         out = pl.pallas_call(
@@ -741,7 +877,21 @@ class PallasEngine(Engine):
             interpret=self.interpret,
         )(bits, cap[None, :], self._lo, self._hi, self._prop, self._selfish, *st)
 
-        out, tele = out[: len(out) - 3], out[len(out) - 3:]
-        new_ctr = SimCounters(tele[0][0], tele[1][0], tele[2][0])
+        n_tail = len(_TELE_LEAVES) + (len(_FLIGHT_LEAVES) if fcap else 0)
+        out, tail = out[: len(out) - n_tail], out[len(out) - n_tail:]
+        new_ctr = SimCounters(
+            tail[0][0], tail[1][0], tail[2][0],
+            jnp.moveaxis(tail[3], -1, 0), jnp.moveaxis(tail[4], -1, 0),
+        )
         new_state, elapsed = jax.vmap(rebase)(self._state_from_kernel(state, out))
-        return new_state, (new_ctr,), elapsed
+        new_fr = None
+        if fcap:
+            fb, fc, fbase = tail[5:]
+            new_fr = advance_base(
+                FlightRecorder(
+                    buf=jnp.moveaxis(fb, -1, 0), count=fc[0],
+                    base_hi=fbase[0], base_lo=fbase[1],
+                ),
+                elapsed,
+            )
+        return new_state, (new_ctr, new_fr), elapsed

@@ -78,24 +78,42 @@ def _phase_rows(spans: list[dict]) -> list[tuple[str, int, float]]:
     )
 
 
+def _sum_vectors(a: list[int] | None, b: list) -> list[int]:
+    """Elementwise sum tolerating length drift across appended ledgers (a
+    roster change between runs writing to one file)."""
+    vb = [int(x) for x in b]
+    if a is None:
+        return vb
+    if len(vb) < len(a):
+        vb += [0] * (len(a) - len(vb))
+    for i, x in enumerate(a):
+        vb[i] += x
+    return vb
+
+
 def _batch_aggregates(batches: list[dict]) -> dict[str, Any] | None:
     """Fold the device-side counters riding in batch-span attrs into the
-    run-level summary (max of maxes, sum of sums, traffic-weighted
-    occupancy). Batches recorded without counters (e.g. a foreign emitter)
-    simply don't contribute."""
+    run-level summary (max of maxes, sum of sums — including the per-miner
+    stale and reorg-depth histograms — and traffic-weighted occupancy).
+    Batches recorded without counters (e.g. a foreign emitter) simply don't
+    contribute."""
     agg: dict[str, Any] = {
         "reorg_depth_max": 0, "stale_events": 0,
         "active_steps": 0, "step_slots": 0, "retries": 0,
+        "stale_by_miner": None, "reorg_depth_hist": None,
     }
     seen = False
     for sp in batches:
-        attrs = sp.get("attrs", {})
+        attrs = sp.get("attrs") or {}
         if "reorg_depth_max" in attrs:
             seen = True
             agg["reorg_depth_max"] = max(agg["reorg_depth_max"], int(attrs["reorg_depth_max"]))
             agg["stale_events"] += int(attrs.get("stale_events", 0))
             agg["active_steps"] += int(attrs.get("active_steps", 0))
             agg["step_slots"] += int(attrs.get("step_slots", 0))
+            for name in ("stale_by_miner", "reorg_depth_hist"):
+                if isinstance(attrs.get(name), list):
+                    agg[name] = _sum_vectors(agg[name], attrs[name])
         agg["retries"] += int(attrs.get("retries", 0))
     if not seen:
         return None
@@ -156,6 +174,12 @@ def render_report(spans: list[dict], fmt: str = "text") -> str:
     )
 
     batches = [sp for sp in spans if sp["span"] == "batch"]
+    if not batches:
+        # Spans-only or foreign ledger (e.g. checkpoint/trace spans alone):
+        # the derived panels have nothing to derive from — say so instead of
+        # assuming batch spans exist.
+        heading("Throughput (batch spans)")
+        out.append("  no data — ledger has no batch spans")
     if batches:
         # An appended ledger can hold several runs (repeated --telemetry to
         # one file); throughput must derive per run_id — the first-batch
@@ -175,7 +199,10 @@ def render_report(spans: list[dict], fmt: str = "text") -> str:
                 else f"Throughput — run {rid}"
             )
             records = [
-                BatchRecord(int(sp["attrs"].get("runs", 0)), float(sp["dur_s"]))
+                BatchRecord(
+                    int((sp.get("attrs") or {}).get("runs", 0)),
+                    float(sp.get("dur_s", 0.0)),
+                )
                 for sp in group
             ]
             a = run_attrs.get(rid, {})
@@ -197,16 +224,18 @@ def render_report(spans: list[dict], fmt: str = "text") -> str:
         stalls = [
             float(sp["attrs"]["stall_s"])
             for sp in batches
-            if "stall_s" in sp.get("attrs", {})
+            if "stall_s" in (sp.get("attrs") or {})
         ]
+        heading("Pipelined-dispatch stall histogram")
         if stalls:
-            heading("Pipelined-dispatch stall histogram")
             hist = _stall_histogram(stalls)
             peak = max(c for _, c in hist)
             table(
                 ["stall", "batches", ""],
                 [[lbl, str(c), _bar(c, peak)] for lbl, c in hist],
             )
+        else:
+            out.append("  no data — batch spans carry no stall_s attr")
 
         agg = _batch_aggregates(batches)
         if agg is not None:
@@ -225,14 +254,38 @@ def render_report(spans: list[dict], fmt: str = "text") -> str:
                 ],
             )
 
+            # Histogram panels (PR 2's scalars collapsed everything to
+            # max/sum; the device counters now keep the distributions).
+            sbm = agg.get("stale_by_miner")
+            if sbm:
+                heading("Stale events by miner")
+                peak = max(sbm)
+                table(
+                    ["miner", "stale events", ""],
+                    [[str(i), str(c), _bar(c, peak)] for i, c in enumerate(sbm)],
+                )
+            rdh = agg.get("reorg_depth_hist")
+            if rdh:
+                heading("Reorg depth histogram")
+                peak = max(rdh)
+                table(
+                    ["depth (own blocks popped)", "events", ""],
+                    [
+                        [f"{d + 1}{'+' if d == len(rdh) - 1 else ''}",
+                         str(c), _bar(c, peak)]
+                        for d, c in enumerate(rdh)
+                    ],
+                )
+
     points = [sp for sp in spans if sp["span"] == "sweep_point"]
     if points:
         heading("Sweep points")
         table(
             ["point", "runs", "elapsed"],
             [
-                [str(sp["attrs"].get("point", "?")),
-                 str(sp["attrs"].get("runs", "?")), _fmt_s(float(sp["dur_s"]))]
+                [str((sp.get("attrs") or {}).get("point", "?")),
+                 str((sp.get("attrs") or {}).get("runs", "?")),
+                 _fmt_s(float(sp.get("dur_s", 0.0)))]
                 for sp in points
             ],
         )

@@ -43,6 +43,7 @@ def make_engine(
     *,
     tile_runs: int | None = None,
     step_block: int | None = None,
+    cache: dict | None = None,
 ):
     """Pick the fastest engine for the platform: the Pallas VMEM kernel
     (tpusim.pallas_engine) on TPU — fast mode for honest rosters, exact mode
@@ -59,7 +60,15 @@ def make_engine(
     platform-default auto preference downgrades quietly.
 
     ``tile_runs``/``step_block`` override the Pallas kernel's measured
-    defaults for on-hardware sweeps (ignored by the scan engine)."""
+    defaults for on-hardware sweeps (ignored by the scan engine).
+
+    ``cache`` (a plain dict the caller owns, e.g. one per sweep) reuses a
+    previously built engine whose :meth:`Engine.reuse_key` matches the fresh
+    candidate's — same compiled-program identity, so a same-shape grid point
+    costs a cheap ``rebind`` instead of a recompile. Construction is always
+    performed (it is what resolves chunk_steps/superstep and validates the
+    config); only the compiled-program cache is shared. Mesh-bound engines
+    participate too — the key carries the mesh's axis/device topology."""
     forced = prefer_pallas is True
     if prefer_pallas is None:
         prefer_pallas = (
@@ -73,6 +82,16 @@ def make_engine(
                 "platform auto-routes to the scan engine; pass "
                 "prefer_pallas/engine='pallas' explicitly or drop the overrides"
             )
+    def from_cache(eng):
+        if cache is None:
+            return eng
+        key = eng.reuse_key()
+        cached = cache.get(key)
+        if cached is not None:
+            return cached.rebind(config, key)
+        cache[key] = eng
+        return eng
+
     if prefer_pallas:
         from .pallas_engine import PallasEngine
 
@@ -82,7 +101,7 @@ def make_engine(
         if step_block is not None:
             kw["step_block"] = step_block
         try:
-            return PallasEngine(config, mesh, **kw)
+            return from_cache(PallasEngine(config, mesh, **kw))
         except ValueError:
             if forced or kw:
                 # Explicit kernel-tuning overrides exist to sweep the kernel;
@@ -90,7 +109,7 @@ def make_engine(
                 # every such sweep point, so they are as strict as forcing.
                 raise
             logger.info("config not eligible for the pallas engine; using scan engine")
-    return Engine(config, mesh)
+    return from_cache(Engine(config, mesh))
 
 
 def make_run_keys(seed: int, start: int, count: int) -> jax.Array:
@@ -143,6 +162,7 @@ def run_simulation_config(
     engine: str = "auto",
     tile_runs: int | None = None,
     step_block: int | None = None,
+    engine_cache: dict | None = None,
 ) -> SimResults:
     """Run ``config.runs`` simulations and aggregate their statistics.
 
@@ -161,7 +181,16 @@ def run_simulation_config(
     simulation counters the engines accumulate in their carried aux
     (engine.SimCounters) — plus ``checkpoint_load``/``checkpoint_save``,
     ``retry``/``engine_fallback`` events, and one closing ``run`` span with
-    the aggregated totals. Render with ``python -m tpusim report``.
+    the aggregated totals plus the environment identity (jax version, device
+    kind/count, tpusim version — telemetry.environment_attrs), so
+    cross-host benchmark ledgers are self-describing. Render with
+    ``python -m tpusim report``.
+
+    ``engine_cache`` (see :func:`make_engine`) lets a sweep driver share one
+    compiled engine across same-shape grid points. Per-run flight-recorder
+    arrays (``SimConfig.flight_capacity > 0``) are dropped here — statistics
+    aggregation has no use for event rows; ``tpusim trace``
+    (tpusim.flight_export) is the collection path for them.
     """
     if engine not in ("auto", "pallas", "scan"):
         raise ValueError(f"unknown engine {engine!r}; use auto, pallas or scan")
@@ -176,7 +205,7 @@ def run_simulation_config(
     prefer_pallas = None if engine == "auto" else (engine == "pallas")
     eng = make_engine(
         config, mesh, prefer_pallas=prefer_pallas,
-        tile_runs=tile_runs, step_block=step_block,
+        tile_runs=tile_runs, step_block=step_block, cache=engine_cache,
     )
     # A trailing remainder that doesn't fill the mesh runs on an unsharded
     # single-device engine rather than silently changing the run count.
@@ -188,6 +217,10 @@ def run_simulation_config(
     fp_dict = json.loads(config.to_json())
     fp_dict.pop("runs", None)
     fp_dict.pop("batch_size", None)
+    # Flight recording is observational — it changes no draw and no statistic
+    # (pinned by tests/test_flight.py) — so it stays out of the fingerprint
+    # and pre-flight checkpoints keep resuming.
+    fp_dict.pop("flight_capacity", None)
     # The superstep width K changes only how many events one device loop
     # iteration unrolls — the per-event draw mapping (and therefore every
     # statistic) is bit-identical across K — so it stays out of the
@@ -233,6 +266,7 @@ def run_simulation_config(
     # "batch" span's attrs.
     tele_run = {"reorg_depth_max": 0, "stale_events": 0, "active_steps": 0,
                 "step_slots": 0, "retries": 0}
+    hist_run = {"stale_by_miner": None, "reorg_depth_hist": None}
 
     def finalize_with_retries(fin, this_engine, keys, start: int):
         """Block on an async batch and apply the retry/fallback policy; a
@@ -347,6 +381,11 @@ def run_simulation_config(
             # them through the telemetry ledger instead.
             tele_b = {k: batch_sums.pop(k) for k in list(batch_sums)
                       if k.startswith("tele_")}
+            # Flight-recorder rows (if the config enabled recording) are
+            # event logs, not statistics: drop them from the sum/checkpoint
+            # path — `tpusim trace` is their collection pipeline.
+            for k in [k for k in batch_sums if k.startswith("flight_")]:
+                del batch_sums[k]
             if tele_b:
                 step_slots = (
                     int(tele_b["tele_chunks_max"]) * eng_p.chunk_steps * nb
@@ -357,6 +396,12 @@ def run_simulation_config(
                 tele_run["stale_events"] += int(tele_b["tele_stale_events_sum"])
                 tele_run["active_steps"] += int(tele_b["tele_active_steps_sum"])
                 tele_run["step_slots"] += step_slots
+                for name in hist_run:
+                    # tpusim-lint: disable=JX002 -- tele_b values are host
+                    # numpy already (run_batch reduces them before returning);
+                    # this is dtype bookkeeping, not a device fetch.
+                    v = np.asarray(tele_b[f"tele_{name}_sum"], dtype=np.int64)
+                    hist_run[name] = v if hist_run[name] is None else hist_run[name] + v
             tele_run["retries"] += attempts
             if telemetry is not None:
                 dur = now - last_done
@@ -371,6 +416,8 @@ def run_simulation_config(
                         active_steps=int(tele_b["tele_active_steps_sum"]),
                         chunks=int(tele_b["tele_chunks_max"]),
                         step_slots=step_slots,
+                        stale_by_miner=tele_b["tele_stale_by_miner_sum"].tolist(),
+                        reorg_depth_hist=tele_b["tele_reorg_depth_hist_sum"].tolist(),
                     )
                 telemetry.emit("batch", t_start=time.time() - dur, dur_s=dur, **attrs)
             last_done = now
@@ -396,17 +443,23 @@ def run_simulation_config(
     elapsed = time.monotonic() - t0
     assert sums is not None
     if telemetry is not None:
+        from .telemetry import environment_attrs
+
         occupancy = (
             tele_run["active_steps"] / tele_run["step_slots"]
             if tele_run["step_slots"] else None
         )
+        hists = {k: v.tolist() for k, v in hist_run.items() if v is not None}
         telemetry.emit(
             "run", t_start=time.time() - elapsed, dur_s=elapsed,
             runs=runs_done, duration_ms=config.duration_ms,
             block_interval_s=config.network.block_interval_s,
             batch_size=batch, mode=config.resolved_mode,
             engine=type(eng).__name__, compile_s=round(compile_s or 0.0, 4),
-            occupancy=occupancy, **tele_run,
+            occupancy=occupancy, **tele_run, **hists,
+            # Environment identity: cross-host ledgers must be
+            # self-describing (the ROADMAP's drift note, now machine-read).
+            **environment_attrs(),
         )
     return SimResults.from_sums(
         sums, config, mode=config.resolved_mode, elapsed_s=elapsed, compile_s=compile_s

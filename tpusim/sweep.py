@@ -124,6 +124,7 @@ def run_sweep(
     quiet: bool = False,
     resume: bool = False,
     telemetry_path: Path | None = None,
+    engine_cache: dict | None = None,
 ) -> list[dict]:
     """Run every point; returns (and optionally appends as JSONL) result dicts.
 
@@ -139,10 +140,21 @@ def run_sweep(
     sweep (tpusim.telemetry): a ``sweep_point`` span per point sharing one
     run_id, with the tpu backend's per-batch spans interleaved under the
     same id — render with ``python -m tpusim report``.
+
+    ``engine_cache`` shares compiled engines across same-shape grid points
+    (tpusim.runner.make_engine): a sweep like selfish-hashrate varies only
+    the roster percentages — runtime inputs of the jitted programs — so
+    every point after the first rebinds the warm engine instead of
+    recompiling (pinned by tests/test_sweep_engine_cache.py). Defaults to a
+    fresh per-call cache on the tpu backend; pass a dict to share across
+    calls.
     """
     import dataclasses
 
     from .backend import get_backend
+
+    if engine_cache is None:
+        engine_cache = {}
 
     if backend not in ("tpu", "cpp"):
         raise ValueError(
@@ -180,7 +192,7 @@ def run_sweep(
         config = dataclasses.replace(config, runs=runs)
         t0 = time.monotonic()
         if backend == "tpu":
-            kwargs = {}
+            kwargs = {"engine_cache": engine_cache}
             if checkpoint_dir is not None:
                 checkpoint_dir.mkdir(parents=True, exist_ok=True)
                 kwargs["checkpoint_path"] = checkpoint_dir / f"{name}.npz"

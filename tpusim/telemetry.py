@@ -50,7 +50,34 @@ __all__ = [
     "throughput_report",
     "load_spans",
     "new_run_id",
+    "environment_attrs",
 ]
+
+
+def environment_attrs() -> dict[str, Any]:
+    """Environment identity for the closing ``run`` span: jax version, device
+    kind/count, and the tpusim version — so benchmark JSONLs gathered from
+    different hosts are self-describing instead of relying on the ROADMAP's
+    prose drift notes. Never raises: telemetry must not take a run down, so
+    lookup failures degrade to whatever fields resolved."""
+    attrs: dict[str, Any] = {}
+    try:
+        from . import __version__
+
+        attrs["tpusim_version"] = __version__
+    except Exception:  # pragma: no cover - import cycle / stripped package
+        pass
+    try:
+        import jax
+
+        attrs["jax_version"] = jax.__version__
+        devices = jax.devices()
+        attrs["device_count"] = len(devices)
+        attrs["device_kind"] = devices[0].device_kind
+        attrs["platform"] = devices[0].platform
+    except Exception:  # pragma: no cover - uninitializable backend
+        pass
+    return attrs
 
 
 def new_run_id() -> str:
