@@ -19,6 +19,29 @@ make -C native check
 echo "== pytest =="
 python -m pytest tests/ -q "$@"
 
+echo "== chaos degradation matrix =="
+# The fault-injection matrix (tpusim.chaos + tests/test_chaos.py): every
+# documented recovery path driven by deterministic injected faults, each
+# recovered run pinned bit-equal to the fault-free run. Runs as its own leg
+# so a chaos regression is named in CI output even when someone runs the
+# pytest leg with a filter.
+env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q -m 'not slow'
+
+echo "== chaos drill smoke =="
+# One CLI-surface drill end-to-end: inject a transient dispatch fault via
+# --chaos, survive it through the retry path, and render the fault ledger.
+chaos_dir=$(mktemp -d)
+cat > "$chaos_dir/plan.json" <<'EOF'
+{"faults": [{"point": "engine.dispatch", "kind": "transient", "count": 1,
+             "when": {"batch": 0}, "note": "ci drill"}]}
+EOF
+env JAX_PLATFORMS=cpu python -m tpusim --runs 4 --batch-size 4 \
+  --duration-ms 86400000 --single-device --quiet \
+  --chaos "$chaos_dir/plan.json" --telemetry "$chaos_dir/drill.jsonl"
+env JAX_PLATFORMS=cpu python -m tpusim report "$chaos_dir/drill.jsonl" \
+  | grep -q "Fault ledger (injected chaos)"
+rm -rf "$chaos_dir"
+
 echo "== telemetry smoke =="
 # One tiny batch end-to-end through the telemetry path: the JSONL ledger must
 # parse and `tpusim report` must render it (exit 0) — the cheapest guard

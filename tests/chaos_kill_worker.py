@@ -1,0 +1,37 @@
+"""Subprocess target for the checkpoint SIGKILL drills (tests/test_chaos.py).
+
+Runs a small checkpointed simulation with a chaos plan that SIGKILLs this
+process at one named boundary of the FIRST checkpoint save — ``begin``
+(before the tmp write), ``pre_replace`` (tmp written + fsynced, rename not
+yet done) or ``post_replace`` (checkpoint durable) — so the parent test can
+resume from whatever the kill left on disk and pin the recovered statistics
+bit-equal to a fault-free run. SIGKILL is unmaskable: if this script prints
+UNREACHABLE, the injection did not fire and the test must fail.
+
+argv: [config_json, phase, checkpoint_path]. The parent sets
+JAX_PLATFORMS=cpu and clears the tunnel trigger env.
+"""
+
+import sys
+
+
+def main() -> None:
+    from tpusim.chaos import ChaosInjector, ChaosPlan, FaultSpec
+    from tpusim.config import SimConfig
+    from tpusim.runner import run_simulation_config
+
+    config = SimConfig.from_json(sys.argv[1])
+    phase = sys.argv[2]
+    plan = ChaosPlan(faults=[
+        FaultSpec(point="checkpoint.save", kind="sigkill", count=1,
+                  when={"phase": phase}),
+    ])
+    run_simulation_config(
+        config, use_all_devices=False, checkpoint_path=sys.argv[3],
+        chaos=ChaosInjector(plan),
+    )
+    print("UNREACHABLE: sigkill fault never fired")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,550 @@
+"""Chaos degradation matrix (tpusim.chaos): every documented recovery path —
+batch retry with backoff, retry exhaustion failing loud, pallas->scan
+engine_fallback, pipelined-fetch watchdog degradation, checkpoint resume
+after SIGKILL at each save boundary, truncated-checkpoint restart, sweep
+resume around a poisoned point, probe timeout fallback, telemetry ENOSPC
+degradation — driven by deterministic injected faults, with every recovered
+run pinned BIT-EQUAL to the fault-free run at the same seed. Plus the
+zero-overhead guarantee: with no chaos plan the compiled programs are
+unchanged and warmed dispatch stays recompile-free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import numpy as np
+import jax
+import pytest
+
+from tpusim.chaos import (
+    ChaosError,
+    ChaosInjector,
+    ChaosPermanentError,
+    ChaosPlan,
+    FaultSpec,
+    PipelineStallError,
+    as_injector,
+    fetch_with_deadline,
+)
+from tpusim.cli import main as cli_main
+from tpusim.config import SimConfig, default_network
+from tpusim.engine import Engine
+from tpusim.probe import TUNNEL_TRIGGER_ENV, probe_backend, probe_or_force_cpu
+from tpusim.runner import run_simulation_config
+from tpusim.sweep import run_sweep
+from tpusim.telemetry import TelemetryRecorder, load_spans
+from tpusim.testing import compile_count_guard
+
+SMALL = SimConfig(
+    network=default_network(propagation_ms=1000),
+    duration_ms=10**8,
+    runs=16,
+    batch_size=8,
+    seed=3,
+)
+
+#: Shared across the module (tpusim.runner.make_engine reuse cache): every
+#: same-shape run_simulation_config call rebinds one warm engine instead of
+#: recompiling, which is what keeps this matrix tier-1-affordable.
+ENGINE_CACHE: dict = {}
+
+
+def plan(*faults: dict) -> ChaosPlan:
+    return ChaosPlan(faults=[FaultSpec(**f) for f in faults])
+
+
+def run_small(**kw):
+    kw.setdefault("use_all_devices", False)
+    kw.setdefault("engine_cache", ENGINE_CACHE)
+    return run_simulation_config(SMALL, **kw)
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    """The fault-free run every recovered run must match bit-for-bit."""
+    return run_small()
+
+
+def assert_results_equal(a, b):
+    assert a.runs == b.runs
+    assert a.table() == b.table()
+    assert a.best_height_mean == b.best_height_mean
+    assert a.overflow_total == b.overflow_total
+    for ma, mb in zip(a.miners, b.miners):
+        assert ma == mb  # exact float equality: the bit-equality discipline
+
+
+# ---------------------------------------------------------------------------
+# Retry policy: transient faults retried with backoff, bit-equal recovery;
+# exhaustion and permanent faults fail loud.
+
+
+def test_retry_then_succeed_bit_equal(baseline, tmp_path):
+    sleeps: list[float] = []
+    rec = TelemetryRecorder(tmp_path / "led.jsonl")
+    res = run_small(
+        chaos=plan({"point": "engine.dispatch", "kind": "transient",
+                    "count": 2, "when": {"batch": 1}}),
+        sleeper=sleeps.append, telemetry=rec,
+    )
+    rec.close()
+    assert_results_equal(res, baseline)
+    # Bounded exponential backoff with deterministic jitter: base 0.5 s
+    # doubling per attempt, jitter in [0, 25%].
+    assert len(sleeps) == 2
+    assert 0.5 <= sleeps[0] <= 0.5 * 1.25
+    assert 1.0 <= sleeps[1] <= 1.0 * 1.25
+    spans = load_spans(rec.path)
+    retries = [s for s in spans if s["span"] == "retry"]
+    assert [r["attrs"]["backoff_s"] for r in retries] == [
+        round(s, 3) for s in sleeps
+    ]
+    assert sum(1 for s in spans if s["span"] == "chaos") == 2
+    # The jitter is a pure function of (seed, start, attempt): a re-drill
+    # backs off identically.
+    sleeps2: list[float] = []
+    res2 = run_small(
+        chaos=plan({"point": "engine.dispatch", "kind": "transient",
+                    "count": 2, "when": {"batch": 1}}),
+        sleeper=sleeps2.append,
+    )
+    assert sleeps2 == sleeps
+    assert_results_equal(res2, baseline)
+
+
+def test_retry_exhaustion_fails_loud():
+    sleeps: list[float] = []
+    with pytest.raises(ChaosError, match="injected transient"):
+        run_small(
+            chaos=plan({"point": "engine.dispatch", "kind": "transient",
+                        "count": -1}),
+            max_retries=1, sleeper=sleeps.append,
+        )
+    assert len(sleeps) == 1  # one backoff, then exhausted -> raise
+
+
+def test_permanent_fault_fails_fast_no_retry():
+    sleeps: list[float] = []
+    with pytest.raises(ChaosPermanentError, match="injected permanent"):
+        run_small(
+            chaos=plan({"point": "engine.dispatch", "kind": "permanent"}),
+            sleeper=sleeps.append,
+        )
+    assert sleeps == []  # config-class errors never consume a retry
+
+
+def test_async_dispatch_fault_retried_synchronously(baseline, caplog, tmp_path):
+    """A fault at the pipelined dispatch stage is absorbed without consuming
+    a retry attempt: the finalize stage re-dispatches synchronously."""
+    rec = TelemetryRecorder(tmp_path / "led.jsonl")
+    with caplog.at_level("ERROR", logger="tpusim"):
+        res = run_small(
+            chaos=plan({"point": "engine.dispatch_async", "kind": "transient",
+                        "count": 1}),
+            telemetry=rec,
+        )
+    rec.close()
+    assert_results_equal(res, baseline)
+    assert any("will retry synchronously" in r.message for r in caplog.records)
+    spans = load_spans(rec.path)
+    assert not [s for s in spans if s["span"] == "retry"]
+    assert [s for s in spans if s["span"] == "chaos"]
+
+
+def test_permanent_fault_fails_fast_on_pallas_too():
+    """The pallas->scan fallback exists for real Mosaic ValueErrors; it must
+    NOT absorb an injected permanent fault — fail-fast holds on every
+    engine, or a drill that must fail loud reports a recovery."""
+    config = SimConfig(
+        network=default_network(propagation_ms=1000),
+        duration_ms=86_400_000, runs=512, batch_size=512, seed=9,
+    )
+    with pytest.raises(ChaosPermanentError, match="injected permanent"):
+        run_simulation_config(
+            config, engine="pallas", use_all_devices=False,
+            chaos=plan({"point": "engine.dispatch", "kind": "permanent",
+                        "when": {"engine": "PallasEngine"}}),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Engine fallback: an injected pallas-side fault lands on the scan twin.
+
+
+def test_engine_fallback_bit_equal(tmp_path):
+    config = SimConfig(
+        network=default_network(propagation_ms=1000),
+        duration_ms=86_400_000, runs=512, batch_size=512, seed=9,
+    )
+    scan = run_simulation_config(config, engine="scan", use_all_devices=False,
+                                 engine_cache=ENGINE_CACHE)
+    rec = TelemetryRecorder(tmp_path / "led.jsonl")
+    via_pallas = run_simulation_config(
+        config, engine="pallas", use_all_devices=False,
+        chaos=plan({"point": "engine.dispatch", "kind": "transient",
+                    "count": 1, "when": {"engine": "PallasEngine"}}),
+        telemetry=rec,
+    )
+    rec.close()
+    assert scan.table() == via_pallas.table()
+    assert scan.best_height_mean == via_pallas.best_height_mean
+    spans = load_spans(rec.path)
+    assert [s for s in spans if s["span"] == "chaos"]
+    assert [s for s in spans if s["span"] == "engine_fallback"]
+
+
+# ---------------------------------------------------------------------------
+# Pipelined dispatch: injected hang and live watchdog, both bit-equal.
+
+
+PIPE = dataclasses.replace(SMALL, runs=16, batch_size=16, chunk_steps=64)
+
+
+@pytest.fixture(scope="module")
+def pipe_engine():
+    return Engine(PIPE)
+
+
+def test_pipelined_hang_degrades_to_synchronous(pipe_engine, caplog):
+    keys = pipe_engine.make_keys(0, PIPE.runs)
+    base = pipe_engine.run_batch(keys)
+    inj = ChaosInjector(plan({"point": "pipeline.flag_fetch", "kind": "hang",
+                              "count": 1}))
+    pipe_engine.chaos = inj
+    try:
+        with caplog.at_level("WARNING", logger="tpusim"):
+            out = pipe_engine.run_batch(keys, pipelined=True)
+    finally:
+        pipe_engine.chaos = None
+    assert len(inj.fired) == 1
+    assert any("re-running the batch synchronously" in r.message
+               for r in caplog.records)
+    assert base.keys() == out.keys()
+    for k in base:
+        np.testing.assert_array_equal(np.asarray(base[k]), np.asarray(out[k]),
+                                      err_msg=k)
+
+
+def test_pipelined_watchdog_live_fetch_bit_equal(pipe_engine):
+    """With a (generous) deadline armed, every done-flag fetch really goes
+    through fetch_with_deadline's watchdog thread — and stays bit-equal."""
+    keys = pipe_engine.make_keys(0, PIPE.runs)
+    base = pipe_engine.run_batch(keys)
+    pipe_engine.flag_fetch_timeout_s = 60.0
+    try:
+        out = pipe_engine.run_batch(keys, pipelined=True)
+    finally:
+        pipe_engine.flag_fetch_timeout_s = None
+    for k in base:
+        np.testing.assert_array_equal(np.asarray(base[k]), np.asarray(out[k]),
+                                      err_msg=k)
+
+
+def test_fetch_with_deadline_unit():
+    assert fetch_with_deadline(lambda: 7, 5.0) == 7
+    with pytest.raises(KeyError):  # exceptions relay unchanged
+        fetch_with_deadline(lambda: {}[0], 5.0)
+    release = threading.Event()
+    try:
+        with pytest.raises(PipelineStallError, match="watchdog deadline"):
+            fetch_with_deadline(lambda: release.wait(30.0), 0.05)
+    finally:
+        release.set()  # unblock the abandoned worker thread
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint durability: SIGKILL at each save boundary, truncated npz.
+
+
+@pytest.mark.parametrize("phase", ["begin", "pre_replace", "post_replace"])
+def test_checkpoint_resume_after_sigkill(phase, baseline, tmp_path, caplog):
+    ck = tmp_path / "ck.npz"
+    tmp_file = ck.with_suffix(".tmp.npz")
+    repo = str(Path(__file__).parent.parent)
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop(TUNNEL_TRIGGER_ENV, None)
+    worker = Path(__file__).parent / "chaos_kill_worker.py"
+    r = subprocess.run(
+        [sys.executable, str(worker), SMALL.to_json(), phase, str(ck)],
+        capture_output=True, text=True, timeout=240, env=env, cwd=repo,
+    )
+    assert r.returncode == -signal.SIGKILL, (r.returncode, r.stdout, r.stderr)
+    assert "UNREACHABLE" not in r.stdout
+    if phase == "begin":
+        assert not ck.exists() and not tmp_file.exists()
+    elif phase == "pre_replace":
+        # The crash window the stale-tmp sweep exists for.
+        assert tmp_file.exists() and not ck.exists()
+    else:
+        assert ck.exists() and not tmp_file.exists()
+    with caplog.at_level("WARNING", logger="tpusim"):
+        resumed = run_small(checkpoint_path=ck)
+    assert_results_equal(resumed, baseline)
+    assert not tmp_file.exists()
+    if phase == "pre_replace":
+        assert any("stale checkpoint temp file" in rec.message
+                   for rec in caplog.records)
+
+
+def test_checkpoint_truncated_npz_restarts_from_zero(baseline, tmp_path, caplog):
+    ck = tmp_path / "ck.npz"
+    run_small(checkpoint_path=ck)
+    data = ck.read_bytes()
+    ck.write_bytes(data[: int(len(data) * 0.6)])  # killed window mid-write
+    with caplog.at_level("WARNING", logger="tpusim"):
+        res = run_small(checkpoint_path=ck)
+    assert any("restarting this point from zero" in rec.message
+               for rec in caplog.records)
+    assert_results_equal(res, baseline)
+
+
+def test_checkpoint_foreign_npz_still_fails_loud(tmp_path):
+    """Corruption tolerance must not extend to a structurally intact npz
+    that simply is not our checkpoint (wrong file / future schema): the zip
+    central directory is written last, so a truncated file can never parse
+    as a valid zip missing only our keys — a missing __config__ means a
+    FOREIGN file, which must never be silently overwritten."""
+    ck = tmp_path / "ck.npz"
+    np.savez(ck, something_else=np.arange(3))
+    with pytest.raises(KeyError):
+        run_small(checkpoint_path=ck)
+
+
+# ---------------------------------------------------------------------------
+# Sweep: a poisoned point fails loud; --resume fills exactly the hole.
+
+
+def _sweep_points():
+    net = default_network(propagation_ms=1000)
+    return [
+        (name, SimConfig(network=net, runs=8, batch_size=8, duration_ms=10**8))
+        for name in ("pt-a", "pt-b", "pt-c")
+    ]
+
+
+def _rows(path: Path) -> list[dict]:
+    return [json.loads(ln) for ln in path.read_text().splitlines() if ln.strip()]
+
+
+def test_sweep_poisoned_point_then_resume_bit_equal(tmp_path):
+    fresh_out = tmp_path / "fresh.jsonl"
+    run_sweep(_sweep_points(), out_path=fresh_out, quiet=True,
+              engine_cache=ENGINE_CACHE)
+
+    out = tmp_path / "sweep.jsonl"
+    with pytest.raises(ChaosPermanentError):
+        run_sweep(
+            _sweep_points(), out_path=out, quiet=True,
+            engine_cache=ENGINE_CACHE,
+            chaos=plan({"point": "sweep.point", "kind": "permanent",
+                        "when": {"target": "pt-b"}}),
+        )
+    assert [r["point"] for r in _rows(out)] == ["pt-a"]
+
+    # The drill's recovery: identical command, --resume, no chaos.
+    run_sweep(_sweep_points(), out_path=out, resume=True, quiet=True,
+              engine_cache=ENGINE_CACHE)
+    got, want = _rows(out), _rows(fresh_out)
+    assert [r["point"] for r in got] == ["pt-a", "pt-b", "pt-c"]
+    for g, w in zip(got, want):
+        for r in (g, w):  # wall-clock attrs differ; statistics must not
+            r.pop("elapsed_s", None)
+            r.pop("compile_s", None)
+        assert g == w
+
+
+# ---------------------------------------------------------------------------
+# Probe: injected dead tunnel -> retries with backoff -> CPU fallback.
+
+
+def test_probe_injected_timeouts_then_none(monkeypatch):
+    msgs: list[str] = []
+    sleeps: list[float] = []
+    inj = ChaosInjector(plan({"point": "probe.attempt", "kind": "hang",
+                              "count": -1}))
+    assert probe_backend(retries=3, log=msgs.append, chaos=inj,
+                         sleeper=sleeps.append) is None
+    assert len(inj.fired) == 3
+    assert "timed out" in msgs[0]
+    assert sleeps == [10.0, 20.0]  # linear probe backoff, injectable sleeper
+
+
+def test_probe_transient_fault_then_real_success(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.delenv(TUNNEL_TRIGGER_ENV, raising=False)
+    msgs: list[str] = []
+    inj = ChaosInjector(plan({"point": "probe.attempt", "kind": "transient",
+                              "count": 1}))
+    assert probe_backend(timeout_s=120, retries=2, log=msgs.append,
+                         chaos=inj, sleeper=lambda s: None) == "cpu"
+    assert "probe failed" in msgs[0]
+
+
+def test_probe_or_force_cpu_on_injected_dead_tunnel(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv(TUNNEL_TRIGGER_ENV, "10.0.0.1")
+    inj = ChaosInjector(plan({"point": "probe.attempt", "kind": "hang",
+                              "count": -1}))
+    assert probe_or_force_cpu(retries=2, log=lambda m: None, chaos=inj,
+                              sleeper=lambda s: None) is None
+    # The fallback cleared the tunnel trigger and pinned this process to CPU.
+    assert TUNNEL_TRIGGER_ENV not in os.environ
+    assert os.environ["JAX_PLATFORMS"] == "cpu"
+
+
+# ---------------------------------------------------------------------------
+# Telemetry: write-side faults degrade the recorder, never the run; a torn
+# ledger stays readable and reportable.
+
+
+def test_telemetry_enospc_degrades_not_dies(baseline, tmp_path, caplog):
+    rec = TelemetryRecorder(tmp_path / "led.jsonl")
+    with caplog.at_level("WARNING", logger="tpusim"):
+        res = run_small(
+            chaos=plan({"point": "telemetry.write", "kind": "enospc",
+                        "count": 1}),
+            telemetry=rec,
+        )
+    rec.close()
+    assert_results_equal(res, baseline)
+    assert any("disabling the recorder" in r.message for r in caplog.records)
+    spans = load_spans(rec.path)
+    # The injector's own span (written before the fault acted) survives; the
+    # faulted span and everything after are dropped, not torn.
+    assert [s["span"] for s in spans] == ["chaos"]
+
+
+def test_export_write_failure_is_clean(tmp_path):
+    """A torn trace-export write (ENOSPC, bad target) fails as one clean
+    line with the partial artifact removed — never a half-written JSON that
+    looks like a deliverable."""
+    from tpusim.flight_export import _write_artifact
+
+    target = tmp_path / "trace.json"
+    target.mkdir()  # write_text -> IsADirectoryError, an OSError
+    with pytest.raises(SystemExit, match="partial file removed"):
+        _write_artifact(target, "{}")
+
+
+def test_torn_ledger_loads_and_reports(tmp_path, capsys):
+    led = tmp_path / "led.jsonl"
+    rec = TelemetryRecorder(led)
+    rec.emit("batch", runs=4, dur_s=0.5)
+    rec.emit("run", runs=4, dur_s=1.0)
+    rec.close()
+    # ENOSPC / SIGKILL mid-write: a trailing fragment cut inside a
+    # multi-byte sequence.
+    with led.open("ab") as fh:
+        fh.write(b'{"run_id": "x", "span": "batch", "attrs"\xe2\x82')
+    spans = load_spans(led)
+    assert [s["span"] for s in spans] == ["batch", "run"]
+    assert cli_main(["report", str(led)]) == 0
+    out = capsys.readouterr().out
+    assert "Phase breakdown" in out
+
+
+# ---------------------------------------------------------------------------
+# Zero overhead when disabled + plan surface.
+
+
+def test_chaos_disabled_compiles_identical_programs(pipe_engine):
+    """No chaos plan => the jitted programs are byte-identical to a chaos-less
+    build (the injector lives entirely outside the traced code), and warmed
+    dispatch stays recompile-free even with an injector attached."""
+    keys_small = Engine(PIPE).make_keys(0, 4)[:4]
+
+    def loop_jaxpr(eng):
+        hi, lo = eng._ledger_init(4)
+        return str(jax.make_jaxpr(
+            lambda k: eng._device_loop(k, hi, lo, eng.params)
+        )(keys_small))
+
+    plain = Engine(PIPE)
+    armed = Engine(PIPE)
+    armed.chaos = ChaosInjector(plan({"point": "engine.run_batch",
+                                      "kind": "transient", "count": 1,
+                                      "when": {"runs": -1}}))  # never matches
+    assert loop_jaxpr(plain) == loop_jaxpr(armed)
+
+    keys = pipe_engine.make_keys(0, PIPE.runs)
+    base = pipe_engine.run_batch(keys)  # warm
+    pipe_engine.chaos = ChaosInjector(plan({"point": "engine.run_batch",
+                                            "kind": "transient", "count": 1,
+                                            "when": {"runs": -1}}))
+    try:
+        with compile_count_guard(exact=0):
+            out = pipe_engine.run_batch(keys)
+    finally:
+        pipe_engine.chaos = None
+    for k in base:
+        np.testing.assert_array_equal(np.asarray(base[k]), np.asarray(out[k]),
+                                      err_msg=k)
+
+
+def test_plan_json_roundtrip_and_validation(tmp_path):
+    p = plan(
+        {"point": "engine.dispatch", "kind": "transient", "count": 2,
+         "when": {"batch": 1}, "note": "drill"},
+        {"point": "checkpoint.save", "kind": "sigkill",
+         "when": {"phase": "pre_replace"}},
+    )
+    assert ChaosPlan.from_json(p.to_json()) == p
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        plan({"point": "x", "kind": "meteor-strike"})
+    with pytest.raises(ValueError, match="count=0"):
+        plan({"point": "x", "count": 0})
+    with pytest.raises(ValueError, match="unknown fault keys"):
+        ChaosPlan.from_dict({"faults": [{"point": "x", "color": "red"}]})
+    with pytest.raises(ValueError, match="needs a point"):
+        plan({"point": ""})
+    # as_injector accepts a plan, an injector, a path, and None.
+    path = tmp_path / "plan.json"
+    path.write_text(p.to_json())
+    assert as_injector(None) is None
+    inj = as_injector(p)
+    assert as_injector(inj) is inj
+    assert as_injector(path).plan == p
+
+
+def test_injector_counts_and_triggers():
+    inj = ChaosInjector(plan(
+        {"point": "a", "kind": "transient", "count": 1, "when": {"k": 1}},
+    ))
+    inj.fire("a", k=2)  # trigger mismatch: no fault
+    inj.fire("b", k=1)  # point mismatch
+    with pytest.raises(ChaosError):
+        inj.fire("a", k=1)
+    inj.fire("a", k=1)  # count exhausted: no fault
+    assert len(inj.fired) == 1
+
+
+def test_cli_chaos_drill_end_to_end(tmp_path, capsys):
+    plan_path = tmp_path / "plan.json"
+    plan_path.write_text(plan(
+        {"point": "engine.dispatch", "kind": "transient", "count": 1}
+    ).to_json())
+    led = tmp_path / "led.jsonl"
+    rc = cli_main([
+        "--runs", "4", "--batch-size", "4", "--duration-ms", "100000000",
+        "--single-device", "--quiet", "--chaos", str(plan_path),
+        "--telemetry", str(led),
+    ])
+    assert rc == 0
+    capsys.readouterr()
+    assert cli_main(["report", str(led)]) == 0
+    out = capsys.readouterr().out
+    assert "Fault ledger (injected chaos)" in out
+    assert "engine.dispatch" in out
+    # The cpp backend has no orchestration seams to poison.
+    with pytest.raises(SystemExit):
+        cli_main(["--backend", "cpp", "--runs", "1", "--chaos", str(plan_path)])

@@ -1,0 +1,294 @@
+"""Deterministic fault injection: named failure seams, drill plans, watchdogs.
+
+The runner grew real resilience primitives for preemptible TPU windows and
+flaky tunnels — batch retry, pallas→scan fallback, fingerprinted npz
+checkpoints, the killable subprocess backend probe — but nothing in the repo
+*exercised* those paths under failure: they were tested only by the happy
+path. This module is the failure generator: a :class:`ChaosPlan` names which
+seam fails, when, and how, and a :class:`ChaosInjector` threads that plan
+through the orchestration layer so every documented recovery path can be
+driven deterministically (tests/test_chaos.py) or drilled by hand
+(``tpusim --chaos plan.json``).
+
+Design constraints, in order:
+
+  * **Device programs are untouched.** Every injection point is host-side
+    Python at an orchestration seam — batch dispatch, done-flag fetch,
+    checkpoint I/O, telemetry writes, the backend probe. Nothing here is
+    traced, so with no plan the compiled programs are byte-identical to a
+    chaos-less build (pinned by tests/test_chaos.py the same way
+    ``flight_capacity=0`` is pinned) and the injector check at each seam is
+    one ``is not None``.
+  * **Deterministic.** A fault fires on an exact (point, trigger-predicate,
+    remaining-count) match — "batch 1, attempt 0, twice" — never on wall
+    clock or randomness, so a drill reproduces bit-for-bit and the
+    degradation-matrix tests can pin recovered runs bit-equal to fault-free
+    runs.
+  * **Observable.** Every injected fault is one ``chaos`` telemetry span
+    (when a recorder is bound), so ``tpusim report`` renders a fault ledger
+    next to the retries/fallbacks it provoked.
+
+Injection points wired through the repo (the plan's ``point`` vocabulary):
+
+  ====================  =====================================================
+  point                 fired from / context keys
+  ====================  =====================================================
+  engine.run_batch      Engine.run_batch(_async) entry; engine, runs
+  engine.dispatch       runner finalize/retry loop; start, batch, attempt,
+                        engine
+  engine.dispatch_async runner pipelined dispatch stage; start
+  pipeline.flag_fetch   Engine._run_batch_pipelined done-flag fetch (kind
+                        "hang" simulates a wedged tunnel; the wall-clock
+                        watchdog path)
+  checkpoint.save       _Checkpoint.save; phase in begin | pre_replace |
+                        post_replace, runs_done ("sigkill" here is the
+                        kill-mid-save drill)
+  checkpoint.load       _Checkpoint.load; path
+  telemetry.write       TelemetryRecorder.emit; target (the span name —
+                        "enospc" exercises the full-disk degradation)
+  probe.attempt         probe_backend per attempt; attempt ("hang" simulates
+                        a dead tunnel probe, "transient" a failing one)
+  sweep.point           run_sweep per grid point; target (the point name),
+                        backend
+  ====================  =====================================================
+
+This module imports no jax (the probe must stay importable before any
+backend touch) and nothing from the rest of the package.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import errno
+import json
+import logging
+import os
+import queue
+import signal
+import threading
+from pathlib import Path
+from typing import Any
+
+logger = logging.getLogger("tpusim")
+
+__all__ = [
+    "FAULT_KINDS",
+    "FaultSpec",
+    "ChaosPlan",
+    "ChaosInjector",
+    "ChaosError",
+    "ChaosPermanentError",
+    "InjectedHang",
+    "PipelineStallError",
+    "fetch_with_deadline",
+    "load_plan",
+    "as_injector",
+]
+
+
+class ChaosError(RuntimeError):
+    """Injected *transient* fault — the class of failure the retry policy
+    exists for (tunnel reset, preempted worker). The runner retries it."""
+
+
+class ChaosPermanentError(ValueError):
+    """Injected *permanent* (config-class) fault. A ``ValueError`` on purpose:
+    the runner's fail-fast rule treats deterministic config errors as
+    unretryable, and an injected permanent fault must take that exact path."""
+
+
+class InjectedHang(Exception):
+    """Marker raised at a fetch/probe seam to simulate a wall-clock hang
+    without sleeping: the call site reports it exactly as a watchdog/timeout
+    expiry, so the degradation path runs in deterministic test time."""
+
+
+class PipelineStallError(RuntimeError):
+    """The pipelined done-flag fetch outlived its wall-clock watchdog
+    deadline (or an injected hang simulated that). Transient by contract:
+    ``Engine.run_batch`` degrades to a synchronous re-run, and a caller that
+    sees it propagate may retry the batch."""
+
+
+#: What an injected fault does when it fires.
+FAULT_KINDS = ("transient", "permanent", "hang", "sigkill", "enospc")
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One fault: where (``point``), what (``kind``), when (``when`` — every
+    key must equal the fired context value, e.g. ``{"batch": 3, "attempt":
+    1}``), and how many times (``count``; < 0 means unlimited)."""
+
+    point: str
+    kind: str = "transient"
+    count: int = 1
+    when: dict[str, Any] = dataclasses.field(default_factory=dict)
+    note: str = ""
+
+    def __post_init__(self) -> None:
+        if not self.point:
+            raise ValueError("fault needs a point name (see tpusim.chaos docstring)")
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; use one of {FAULT_KINDS}"
+            )
+        if self.count == 0:
+            raise ValueError("count=0 never fires; use a positive count (or < 0 for unlimited)")
+
+    def matches(self, ctx: dict[str, Any]) -> bool:
+        return all(ctx.get(k) == v for k, v in self.when.items())
+
+
+@dataclasses.dataclass
+class ChaosPlan:
+    """A drill: the ordered fault list. JSON shape::
+
+        {"faults": [
+          {"point": "engine.dispatch", "kind": "transient", "count": 2,
+           "when": {"batch": 1}, "note": "retry drill"}
+        ]}
+    """
+
+    faults: list[FaultSpec] = dataclasses.field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "ChaosPlan":
+        raw = d.get("faults", [])
+        if not isinstance(raw, list):
+            raise ValueError('chaos plan must be {"faults": [...]}')
+        faults = []
+        for f in raw:
+            known = {"point", "kind", "count", "when", "note"}
+            extra = set(f) - known
+            if extra:
+                raise ValueError(f"unknown fault keys {sorted(extra)}; known: {sorted(known)}")
+            faults.append(FaultSpec(**f))
+        return ChaosPlan(faults=faults)
+
+    @staticmethod
+    def from_json(text: str) -> "ChaosPlan":
+        return ChaosPlan.from_dict(json.loads(text))
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"faults": [dataclasses.asdict(f) for f in self.faults]}, indent=2
+        )
+
+
+def load_plan(path: str | Path) -> ChaosPlan:
+    return ChaosPlan.from_json(Path(path).read_text())
+
+
+class ChaosInjector:
+    """The live, counted instance of a plan, threaded through one run/sweep.
+
+    ``fire(point, **ctx)`` is called at every wired seam; it scans the plan
+    for an armed fault matching (point, ctx), decrements its remaining
+    count, records it on the ``fired`` ledger (and as a ``chaos`` telemetry
+    span when a recorder is bound), then acts: raise
+    :class:`ChaosError`/:class:`ChaosPermanentError`/:class:`InjectedHang`/
+    ``OSError(ENOSPC)``, or SIGKILL this process. At most one fault fires
+    per call. No match is a cheap no-op — and call sites guard with
+    ``if chaos is not None`` so a chaos-less run pays nothing at all.
+    """
+
+    def __init__(self, plan: ChaosPlan, telemetry=None):
+        self.plan = plan
+        self.telemetry = telemetry
+        self._remaining = [f.count for f in plan.faults]
+        #: Ledger of fired faults, newest last: {point, kind, **ctx}.
+        self.fired: list[dict[str, Any]] = []
+
+    def bind_telemetry(self, recorder) -> None:
+        """Adopt the run's recorder (first binding wins, so a CLI-built
+        injector keeps the recorder it was constructed with)."""
+        if self.telemetry is None:
+            self.telemetry = recorder
+
+    def fire(self, point: str, /, **ctx: Any) -> None:
+        for i, fault in enumerate(self.plan.faults):
+            if fault.point != point or self._remaining[i] == 0:
+                continue
+            if not fault.matches(ctx):
+                continue
+            if self._remaining[i] > 0:
+                self._remaining[i] -= 1
+            record = {"point": point, "kind": fault.kind, **ctx}
+            self.fired.append(record)
+            logger.warning("chaos: injecting %s fault at %s %s", fault.kind, point, ctx)
+            if self.telemetry is not None:
+                # Emitted BEFORE acting: the recorder is line-buffered, so
+                # even the sigkill drill leaves its own span in the ledger.
+                # (The recorder skips its telemetry.write hook for "chaos"
+                # spans, so this cannot recurse into another injection.)
+                self.telemetry.emit("chaos", point=point, kind=fault.kind, **ctx)
+            self._act(fault, point)
+            return
+
+    def _act(self, fault: FaultSpec, point: str) -> None:
+        msg = f"injected {fault.kind} fault at {point}"
+        if fault.note:
+            msg += f" ({fault.note})"
+        if fault.kind == "transient":
+            raise ChaosError(msg)
+        if fault.kind == "permanent":
+            raise ChaosPermanentError(msg)
+        if fault.kind == "hang":
+            raise InjectedHang(msg)
+        if fault.kind == "enospc":
+            raise OSError(errno.ENOSPC, msg)
+        # sigkill: the mid-save / mid-window preemption drill. SIGKILL is
+        # unmaskable — no finally blocks, no atexit, exactly like a
+        # preempted TPU VM disappearing under the run.
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def as_injector(chaos) -> ChaosInjector | None:
+    """Coerce the public plumbing surface — None, a :class:`ChaosPlan`, an
+    existing injector, or a path to a plan JSON — into the one injector
+    instance threaded through a run. Shared by runner/sweep/CLI so every
+    entry point accepts the same spellings."""
+    if chaos is None or isinstance(chaos, ChaosInjector):
+        return chaos
+    if isinstance(chaos, ChaosPlan):
+        return ChaosInjector(chaos)
+    return ChaosInjector(load_plan(chaos))
+
+
+def fetch_with_deadline(thunk, timeout_s: float, what: str = "done-flag fetch"):
+    """Run a blocking device fetch with a wall-clock watchdog.
+
+    The tunneled TPU backend can wedge a transfer inside C land where no
+    signal-based timeout fires (the same failure mode tpusim.probe exists
+    for, here striking mid-pipeline). The fetch therefore runs on a daemon
+    thread; if it outlives ``timeout_s`` a :class:`PipelineStallError` is
+    raised and the thread is abandoned — it cannot be cancelled, but the
+    caller's degradation path (synchronous re-dispatch) no longer depends
+    on it. Results/exceptions from a fetch that completes in time are
+    returned/re-raised unchanged.
+
+    Cost: one short-lived thread + queue per call. The pipelined loop
+    fetches once per multi-second chunk, so the ~50 us spawn is noise, and
+    at most ONE thread can leak per batch — the first stall aborts the
+    pipelined loop (run_batch degrades to a synchronous re-dispatch), so a
+    wedged tunnel never accumulates a blocked thread per chunk.
+    """
+    out: queue.Queue = queue.Queue(maxsize=1)
+
+    def worker() -> None:
+        try:
+            out.put((True, thunk()))
+        except BaseException as e:  # noqa: BLE001 — relayed to the caller
+            out.put((False, e))
+
+    threading.Thread(target=worker, daemon=True, name="tpusim-fetch-watchdog").start()
+    try:
+        ok, value = out.get(timeout=timeout_s)
+    except queue.Empty:
+        raise PipelineStallError(
+            f"{what} exceeded the {timeout_s:.1f}s wall-clock watchdog deadline"
+        ) from None
+    if ok:
+        return value
+    raise value

@@ -109,6 +109,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="append structured run spans (batches, checkpoints, retries, "
         "device-side sim counters) here; render with `tpusim report`",
     )
+    p.add_argument(
+        "--chaos", type=Path, metavar="PLAN",
+        help="JSON chaos plan (tpusim.chaos): deterministic fault-injection "
+        "drill — injected faults land as `chaos` telemetry spans and the "
+        "run must survive through the documented recovery paths",
+    )
     return p
 
 
@@ -201,6 +207,11 @@ def main(argv: list[str] | None = None) -> int:
                 "error: --engine picks the JAX execution engine; "
                 "the cpp backend has none"
             )
+        if args.chaos:
+            raise SystemExit(
+                "error: --chaos injects faults at the tpu backend's "
+                "orchestration seams; the cpp backend has none"
+            )
         if args.tile_runs is not None or args.step_block is not None:
             raise SystemExit(
                 "error: --tile-runs/--step-block tune the pallas kernel; "
@@ -242,6 +253,12 @@ def main(argv: list[str] | None = None) -> int:
 
             recorder = TelemetryRecorder(args.telemetry)
 
+        chaos = None
+        if args.chaos:
+            from .chaos import ChaosInjector, load_plan
+
+            chaos = ChaosInjector(load_plan(args.chaos))
+
         from contextlib import nullcontext
 
         try:
@@ -256,6 +273,7 @@ def main(argv: list[str] | None = None) -> int:
                     engine=args.engine,
                     tile_runs=args.tile_runs,
                     step_block=args.step_block,
+                    chaos=chaos,
                 )
         finally:
             if recorder is not None:
@@ -267,6 +285,10 @@ def main(argv: list[str] | None = None) -> int:
         if recorder is not None and not args.quiet:
             print(f"[telemetry] {args.telemetry} (run_id {recorder.run_id}; "
                   f"render: python -m tpusim report {args.telemetry})")
+        if chaos is not None and not args.quiet:
+            # Reaching this line IS the drill's pass criterion: every
+            # injected fault was survived through a documented recovery path.
+            print(f"[chaos] survived {len(chaos.fired)} injected fault(s)")
     print(results.table())
     if results.overflow_total:
         print(f"  [diagnostics: {results.overflow_total} group-slot overflows]")

@@ -35,6 +35,7 @@ serialized with the config and covered by the checkpoint fingerprint.
 
 from __future__ import annotations
 
+import logging
 import math
 from typing import NamedTuple
 
@@ -44,6 +45,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from .compat import shard_map
 
+from .chaos import InjectedHang, PipelineStallError, fetch_with_deadline
 from .config import SimConfig
 from .sampling import interval_from_bits, winner_from_bits
 from .state import (
@@ -63,6 +65,8 @@ __all__ = [
     "Engine", "SimCounters", "default_n_steps", "resolve_superstep",
     "DEFAULT_SUPERSTEP", "DEPTH_BUCKETS",
 ]
+
+logger = logging.getLogger("tpusim")
 
 #: Per-batch int32 block-count sums stay exact below this many blocks.
 _I32_SUM_GUARD = 2**31 - 1
@@ -338,6 +342,17 @@ class Engine:
     def __init__(self, config: SimConfig, mesh: Mesh | None = None):
         self.config = config
         self.mesh = mesh
+        # Fault-injection seam (tpusim.chaos): host-side only, never traced —
+        # a None injector costs one `is not None` per batch and leaves the
+        # compiled programs byte-identical to a chaos-less build (pinned by
+        # tests/test_chaos.py).
+        self.chaos = None
+        #: Wall-clock watchdog for the pipelined done-flag fetch; None (the
+        #: default) keeps the fetch a plain transfer with zero extra
+        #: machinery. Set (seconds) to detect a wedged tunnel mid-pipeline:
+        #: an overdue fetch raises PipelineStallError, which run_batch
+        #: degrades to a synchronous re-dispatch of the batch.
+        self.flag_fetch_timeout_s: float | None = None
         self.params = make_params(config)
         self.n_miners = config.network.n_miners
         self.exact = config.resolved_mode == "exact"
@@ -810,17 +825,18 @@ class Engine:
             flags.append(unfin)
             if len(flags) > self._PIPELINE_DEPTH:
                 popped += 1
-                # tpusim-lint: disable=JX002 -- the ONE sanctioned sync of the
-                # pipelined loop: this flag's chunk was dispatched depth chunks
-                # ago, so the fetch only blocks when the host is already ahead.
-                if int(flags.popleft()) == 0:
+                # The ONE sanctioned sync of the pipelined loop: this flag's
+                # chunk was dispatched depth chunks ago, so the fetch only
+                # blocks when the host is already ahead (and _fetch_flag's
+                # watchdog bounds how long "blocks" may mean).
+                if self._fetch_flag(flags.popleft()) == 0:
                     finished = True
                     break
         while not finished and flags:
             popped += 1
-            # tpusim-lint: disable=JX002 -- drain after the last dispatch; the
-            # device is the critical path here by construction.
-            finished = int(flags.popleft()) == 0
+            # Drain after the last dispatch; the device is the critical path
+            # here by construction.
+            finished = self._fetch_flag(flags.popleft()) == 0
         if not finished:
             raise RuntimeError(
                 f"batch did not finish within {self.max_chunks} chunks of "
@@ -840,6 +856,35 @@ class Engine:
         _host_reduce_telemetry(out, popped)
         out["runs"] = np.int64(n)
         return out
+
+    def _fetch_flag(self, flag) -> int:
+        """Fetch one pipelined done-flag, through the chaos seam and (when
+        ``flag_fetch_timeout_s`` is set) the wall-clock watchdog. Both
+        failure shapes — an injected hang and a genuinely overdue transfer —
+        surface as :class:`tpusim.chaos.PipelineStallError`, the signal
+        :meth:`run_batch` degrades on."""
+        if self.chaos is not None:
+            try:
+                self.chaos.fire("pipeline.flag_fetch")
+            except InjectedHang as e:
+                raise PipelineStallError(str(e)) from None
+        if self.flag_fetch_timeout_s is not None:
+            return fetch_with_deadline(
+                lambda: int(flag), self.flag_fetch_timeout_s,
+                what="pipelined done-flag fetch",
+            )
+        # tpusim-lint: disable=JX002 -- the sanctioned pipelined-loop sync;
+        # see the call sites in _run_batch_pipelined.
+        return int(flag)
+
+    def _fire_dispatch(self, n: int) -> None:
+        """The engine-level chaos seam: fires once per batch dispatch, on
+        whichever entry path the batch takes (async device loop, pipelined,
+        host loop)."""
+        if self.chaos is not None:
+            self.chaos.fire(
+                "engine.run_batch", engine=type(self).__name__, runs=n
+            )
 
     def _batch_guard(self, n: int) -> None:
         duration = self.config.duration_ms
@@ -874,8 +919,22 @@ class Engine:
         self._batch_guard(n)
         if self._device_loop_ok(n) and not host_loop:
             if pipelined:
-                return self._run_batch_pipelined(keys)
+                self._fire_dispatch(n)
+                try:
+                    return self._run_batch_pipelined(keys)
+                except PipelineStallError as e:
+                    # Watchdog degradation: a wedged done-flag fetch must not
+                    # hang the run. The pipelined loop's buffers were donated
+                    # chunk-to-chunk but `keys` was not, so the batch can be
+                    # re-dispatched from scratch synchronously — same draws,
+                    # bit-identical sums, one batch of lost work.
+                    logger.warning(
+                        "pipelined dispatch stalled (%s); re-running the "
+                        "batch synchronously", e,
+                    )
+                    return self.run_batch_async(keys)()
             return self.run_batch_async(keys)()
+        self._fire_dispatch(n)
         return self._run_batch_hostloop(keys)
 
     def run_batch_async(self, keys: jax.Array):
@@ -890,6 +949,7 @@ class Engine:
         eligible."""
         n = keys.shape[0]
         self._batch_guard(n)
+        self._fire_dispatch(n)
         if not self._device_loop_ok(n):
             out = self._run_batch_hostloop(keys)
             return lambda: out

@@ -169,6 +169,24 @@ def validate_perfetto(trace: Any) -> int:
     return n
 
 
+def _write_artifact(path: Path, text: str) -> None:
+    """Write one export artifact, failing CLEAN on a torn write: a half-
+    written trace JSON (ENOSPC, yanked volume) parses as nothing yet still
+    looks like a deliverable, so the partial file is removed and the error
+    reported as one line instead of a stack trace."""
+    path.parent.mkdir(parents=True, exist_ok=True)
+    try:
+        path.write_text(text)
+    except OSError as e:
+        try:
+            path.unlink(missing_ok=True)
+        except OSError:
+            pass
+        raise SystemExit(
+            f"error: writing {path} failed ({e}); partial file removed"
+        ) from None
+
+
 def main(argv: list[str] | None = None) -> int:
     """``tpusim trace``: run a (small) simulation with the flight recorder on
     and export the ring as Perfetto JSON + optional JSONL event log. Accepts
@@ -241,11 +259,9 @@ def main(argv: list[str] | None = None) -> int:
         },
     )
     validate_perfetto(trace)
-    args.trace_out.parent.mkdir(parents=True, exist_ok=True)
-    args.trace_out.write_text(json.dumps(trace))
+    _write_artifact(args.trace_out, json.dumps(trace))
     if args.events_out is not None:
-        args.events_out.parent.mkdir(parents=True, exist_ok=True)
-        args.events_out.write_text(events_jsonl(log.events))
+        _write_artifact(args.events_out, events_jsonl(log.events))
     if args.telemetry:
         # Correlate with the span ledger: the trace span carries the SAME
         # run_id as the exported file's otherData.

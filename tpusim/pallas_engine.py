@@ -720,6 +720,11 @@ class PallasEngine(Engine):
             self._scan_fallback = Engine(
                 dataclasses.replace(self.config, chunk_steps=self.chunk_steps)
             )
+        # The twin serves the same logical batch: it inherits the fault-
+        # injection seam and the pipelined-fetch watchdog (refreshed on
+        # every call — the runner may attach/detach chaos between batches).
+        self._scan_fallback.chaos = self.chaos
+        self._scan_fallback.flag_fetch_timeout_s = self.flag_fetch_timeout_s
         return self._scan_fallback
 
     def run_batch(self, keys, *, host_loop: bool = False, pipelined: bool = False):

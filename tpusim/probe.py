@@ -19,6 +19,8 @@ import sys
 import time
 from typing import Callable
 
+from .chaos import ChaosError, InjectedHang
+
 #: The env var this container's sitecustomize uses as the trigger to register
 #: the tunneled TPU PJRT plugin at interpreter startup. probe_or_force_cpu
 #: clears it so *child* processes skip the dead tunnel entirely; if the
@@ -31,32 +33,56 @@ def probe_backend(
     retries: int = 3,
     backoff_s: float = 10.0,
     log: Callable[[str], None] | None = None,
+    *,
+    chaos=None,
+    sleeper: Callable[[float], None] | None = None,
 ) -> str | None:
     """Return the platform name jax sees ("tpu", "cpu", ...) or None if the
-    backend never comes up within ``retries`` subprocess probes."""
+    backend never comes up within ``retries`` subprocess probes.
+
+    ``chaos`` (a tpusim.chaos.ChaosInjector) arms the ``probe.attempt``
+    fault seam: an injected "hang" is reported exactly like a killed-on-
+    timeout probe and a "transient" like a failing one — the dead-tunnel
+    drill without a dead tunnel. ``sleeper`` overrides the inter-attempt
+    ``time.sleep`` (tests inject a recorder instead of waiting)."""
     say = log or (lambda msg: print(f"[probe] {msg}", file=sys.stderr, flush=True))
+    sleep = sleeper if sleeper is not None else time.sleep
     code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
     for attempt in range(retries):
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", code],
-                capture_output=True, text=True, timeout=timeout_s,
-                env=os.environ.copy(),
-            )
-        except subprocess.TimeoutExpired:
-            say(f"backend probe timed out after {timeout_s:.0f}s")
-            r = None
-        if r is not None:
-            if r.returncode == 0:
-                for line in r.stdout.splitlines():
-                    if line.startswith("PLATFORM="):
-                        return line.split("=", 1)[1]
-            tail = (r.stderr or "").strip().splitlines()[-1:] or ["?"]
-            say(f"backend probe failed rc={r.returncode}: {tail[0][:200]}")
+        r = None
+        injected = False
+        if chaos is not None:
+            try:
+                chaos.fire("probe.attempt", attempt=attempt)
+            except InjectedHang:
+                # The subprocess would have been killed at timeout_s; the
+                # caller-visible outcome is identical.
+                say(f"backend probe timed out after {timeout_s:.0f}s")
+                injected = True
+            except ChaosError as e:
+                say(f"backend probe failed rc=-1: {e}")
+                injected = True
+        if not injected:
+            try:
+                r = subprocess.run(
+                    [sys.executable, "-c", code],
+                    capture_output=True, text=True, timeout=timeout_s,
+                    env=os.environ.copy(),
+                )
+            except subprocess.TimeoutExpired:
+                say(f"backend probe timed out after {timeout_s:.0f}s")
+                r = None
+            if r is not None:
+                if r.returncode == 0:
+                    for line in r.stdout.splitlines():
+                        if line.startswith("PLATFORM="):
+                            return line.split("=", 1)[1]
+                tail = (r.stderr or "").strip().splitlines()[-1:] or ["?"]
+                say(f"backend probe failed rc={r.returncode}: {tail[0][:200]}")
         if attempt + 1 < retries:
             pause = backoff_s * (attempt + 1)
             say(f"retrying backend probe in {pause:.0f}s ({attempt + 1}/{retries})")
-            time.sleep(pause)
+            sleep(pause)
     return None
 
 
@@ -65,6 +91,9 @@ def probe_or_force_cpu(
     retries: int = 3,
     backoff_s: float = 10.0,
     log: Callable[[str], None] | None = None,
+    *,
+    chaos=None,
+    sleeper: Callable[[float], None] | None = None,
 ) -> str | None:
     """Probe the accelerator; on failure, force this process onto local CPU.
 
@@ -80,7 +109,9 @@ def probe_or_force_cpu(
     bench.py and __graft_entry__.entry (the sweep CLI instead fails loudly
     — a silent CPU sweep would waste hours).
     """
-    platform = probe_backend(timeout_s, retries, backoff_s, log)
+    platform = probe_backend(
+        timeout_s, retries, backoff_s, log, chaos=chaos, sleeper=sleeper
+    )
     if platform is None:
         force_cpu()
     return platform

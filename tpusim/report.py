@@ -277,6 +277,24 @@ def render_report(spans: list[dict], fmt: str = "text") -> str:
                     ],
                 )
 
+    faults = [sp for sp in spans if sp["span"] == "chaos"]
+    if faults:
+        # The fault ledger: every injected fault of a chaos drill
+        # (tpusim.chaos), in firing order, next to the retries/fallbacks it
+        # provoked in the phase breakdown above.
+        heading("Fault ledger (injected chaos)")
+        rows = []
+        for i, sp in enumerate(faults):
+            attrs = sp.get("attrs") or {}
+            ctx = ", ".join(
+                f"{k}={v}" for k, v in attrs.items() if k not in ("point", "kind")
+            )
+            rows.append(
+                [str(i), str(attrs.get("point", "?")), str(attrs.get("kind", "?")),
+                 ctx or "-"]
+            )
+        table(["#", "point", "kind", "context"], rows)
+
     points = [sp for sp in spans if sp["span"] == "sweep_point"]
     if points:
         heading("Sweep points")

@@ -16,7 +16,10 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import os
+import random
 import time
+import zipfile
 from pathlib import Path
 from typing import Callable
 
@@ -25,6 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
+from .chaos import ChaosInjector, ChaosPermanentError, as_injector
 from .config import SimConfig
 from .engine import Engine
 from .profiling import Profiler
@@ -33,7 +37,10 @@ from .telemetry import TelemetryRecorder
 
 logger = logging.getLogger("tpusim")
 
-__all__ = ["run_simulation_config", "make_run_keys", "make_engine"]
+__all__ = [
+    "run_simulation_config", "make_run_keys", "make_engine",
+    "CheckpointMismatchError",
+]
 
 
 def make_engine(
@@ -124,29 +131,87 @@ def _zero_sums(template: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
             for k, v in template.items()}
 
 
+class CheckpointMismatchError(ValueError):
+    """A checkpoint written by a *different config* — a real operator error
+    that must fail loud (merging statistics across configs is silent data
+    corruption), unlike a *corrupt* checkpoint, which is an expected outcome
+    of a killed window and restarts the point from zero."""
+
+
 @dataclasses.dataclass
 class _Checkpoint:
     path: Path
     fingerprint: str  # config JSON; a resumed sweep must match it exactly
+    chaos: ChaosInjector | None = None
+
+    def _tmp(self) -> Path:
+        return self.path.with_suffix(".tmp.npz")
 
     def load(self) -> tuple[int, dict[str, np.ndarray]] | None:
+        tmp = self._tmp()
+        if tmp.exists():
+            # A crash between the tmp write and the atomic replace used to
+            # leave this file orphaned forever. Its contents are unverified
+            # (possibly torn mid-write), so it is swept, never adopted.
+            logger.warning(
+                "removing stale checkpoint temp file %s (crash mid-save?)", tmp
+            )
+            tmp.unlink(missing_ok=True)
+        if self.chaos is not None:
+            self.chaos.fire("checkpoint.load", path=str(self.path))
         if not self.path.exists():
             return None
-        with np.load(self.path, allow_pickle=False) as data:
-            saved_fp = str(data["__config__"])
-            if saved_fp != self.fingerprint:
-                raise ValueError(
-                    f"checkpoint {self.path} was written by a different config; "
-                    f"refusing to merge statistics across configs"
-                )
-            runs_done = int(data["__runs_done__"])
-            sums = {k: data[k] for k in data.files if not k.startswith("__")}
+        try:
+            with np.load(self.path, allow_pickle=False) as data:
+                saved_fp = str(data["__config__"])
+                if saved_fp != self.fingerprint:
+                    raise CheckpointMismatchError(
+                        f"checkpoint {self.path} was written by a different config; "
+                        f"refusing to merge statistics across configs"
+                    )
+                runs_done = int(data["__runs_done__"])
+                sums = {k: data[k] for k in data.files if not k.startswith("__")}
+        except CheckpointMismatchError:
+            raise
+        except (zipfile.BadZipFile, ValueError, OSError, EOFError) as e:
+            # A window killed mid-write (timeout -k) can leave a truncated
+            # npz; np.load surfaces that as BadZipFile/ValueError/EOFError
+            # depending on where the cut landed. Restart the point from zero
+            # instead of crashing the whole sweep — the same tolerance
+            # policy as sweep.py's truncated-JSONL repair. KeyError is NOT
+            # tolerated: the zip central directory is written last, so a
+            # structurally intact npz missing __config__/__runs_done__ is a
+            # foreign file, not a torn one — overwriting it silently would
+            # be the data-loss class CheckpointMismatchError exists for.
+            logger.warning(
+                "checkpoint %s is unreadable (%s: %s); restarting this point "
+                "from zero", self.path, type(e).__name__, e,
+            )
+            return None
         return runs_done, sums
 
     def save(self, runs_done: int, sums: dict[str, np.ndarray]) -> None:
-        tmp = self.path.with_suffix(".tmp.npz")
-        np.savez(tmp, __runs_done__=runs_done, __config__=self.fingerprint, **sums)
+        tmp = self._tmp()
+        if self.chaos is not None:
+            self.chaos.fire("checkpoint.save", phase="begin", runs_done=runs_done)
+        # fsync before the rename and the directory after it: without both,
+        # a host crash shortly after "saving" can leave the *renamed* file
+        # empty or the rename itself unjournaled — the checkpoint then reads
+        # as corrupt exactly when it is needed (the crash it exists for).
+        with open(tmp, "wb") as fh:
+            np.savez(fh, __runs_done__=runs_done, __config__=self.fingerprint, **sums)
+            fh.flush()
+            os.fsync(fh.fileno())
+        if self.chaos is not None:
+            self.chaos.fire("checkpoint.save", phase="pre_replace", runs_done=runs_done)
         tmp.replace(self.path)
+        dir_fd = os.open(self.path.parent, os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
+        if self.chaos is not None:
+            self.chaos.fire("checkpoint.save", phase="post_replace", runs_done=runs_done)
 
 
 def run_simulation_config(
@@ -157,12 +222,15 @@ def run_simulation_config(
     progress: Callable[[int, int], None] | None = None,
     checkpoint_path: str | Path | None = None,
     max_retries: int = 2,
+    retry_backoff_s: float = 0.5,
+    sleeper: Callable[[float], None] | None = None,
     profiler: "Profiler | None" = None,
     telemetry: "TelemetryRecorder | None" = None,
     engine: str = "auto",
     tile_runs: int | None = None,
     step_block: int | None = None,
     engine_cache: dict | None = None,
+    chaos=None,
 ) -> SimResults:
     """Run ``config.runs`` simulations and aggregate their statistics.
 
@@ -191,9 +259,28 @@ def run_simulation_config(
     arrays (``SimConfig.flight_capacity > 0``) are dropped here — statistics
     aggregation has no use for event rows; ``tpusim trace``
     (tpusim.flight_export) is the collection path for them.
+
+    Failed batches retry up to ``max_retries`` times with bounded
+    exponential backoff from ``retry_backoff_s`` (doubling per attempt,
+    capped at 30 s) plus deterministic jitter derived from (seed, start,
+    attempt) — reproducible in drills, desynchronized across a fleet.
+    ``sleeper`` overrides ``time.sleep`` (tests inject a recorder).
+
+    ``chaos`` — a :class:`tpusim.chaos.ChaosPlan`/``ChaosInjector``/plan-JSON
+    path — arms deterministic fault injection at the orchestration seams
+    (dispatch, checkpoint I/O, telemetry writes, the pipelined fetch); every
+    injected fault lands as a ``chaos`` telemetry span. None (the default)
+    leaves every seam a no-op check.
     """
     if engine not in ("auto", "pallas", "scan"):
         raise ValueError(f"unknown engine {engine!r}; use auto, pallas or scan")
+    chaos = as_injector(chaos)
+    if chaos is not None and telemetry is not None:
+        chaos.bind_telemetry(telemetry)
+        # Both directions: the injector reports through the recorder, and
+        # the recorder's own writes are a chaos seam (telemetry.write).
+        telemetry.chaos = chaos
+    _sleep = sleeper if sleeper is not None else time.sleep
     if mesh is None and use_all_devices and len(jax.devices()) > 1:
         mesh = Mesh(np.array(jax.devices()), ("runs",))
 
@@ -207,6 +294,9 @@ def run_simulation_config(
         config, mesh, prefer_pallas=prefer_pallas,
         tile_runs=tile_runs, step_block=step_block, cache=engine_cache,
     )
+    # Always (re)assigned: a cache-shared engine may carry a previous run's
+    # injector, and this run's policy — chaos or none — must win.
+    eng.chaos = chaos
     # A trailing remainder that doesn't fill the mesh runs on an unsharded
     # single-device engine rather than silently changing the run count.
     engine_unsharded: Engine | None = None
@@ -244,7 +334,10 @@ def run_simulation_config(
     # the step->key sampling identity.
     fp_dict["chunk_steps"] = eng.chunk_steps
     fingerprint = json.dumps(fp_dict, sort_keys=True)
-    ckpt = _Checkpoint(Path(checkpoint_path), fingerprint) if checkpoint_path else None
+    ckpt = (
+        _Checkpoint(Path(checkpoint_path), fingerprint, chaos=chaos)
+        if checkpoint_path else None
+    )
     runs_done, sums = 0, None
     if ckpt is not None:
         t_ld = time.perf_counter()
@@ -278,11 +371,23 @@ def run_simulation_config(
         attempts = 0
         while True:
             try:
+                if chaos is not None:
+                    chaos.fire(
+                        "engine.dispatch", start=start, batch=start // batch,
+                        attempt=attempts, engine=type(this_engine).__name__,
+                    )
                 if fin is not None:
                     out, fin = fin, None  # one shot: retries re-dispatch sync
                     return out(), attempts, this_engine
                 return this_engine.run_batch(keys), attempts, this_engine
             except Exception as e:  # noqa: BLE001 — batch-level retry is the point
+                if isinstance(e, ChaosPermanentError):
+                    # An injected permanent fault must fail fast on EVERY
+                    # engine: the pallas branch below exists for real Mosaic
+                    # lowering ValueErrors, and letting it absorb a drill's
+                    # permanent fault would report a recovery the guarantee
+                    # matrix forbids.
+                    raise
                 if not hasattr(this_engine, "scan_twin") \
                         and isinstance(e, (ValueError, TypeError)):
                     # Deterministic config errors (e.g. the int32 block-count
@@ -310,15 +415,31 @@ def run_simulation_config(
                     this_engine = twin
                     continue
                 attempts += 1
+                exhausted = attempts > max_retries
+                # Bounded exponential backoff with deterministic jitter: an
+                # immediate retry hammers whatever infrastructure just failed
+                # (and a fleet of workers retrying in lockstep hammers it
+                # together). The jitter derives from (seed, start, attempt) —
+                # ints only, so hash() is unsalted — never from wall clock:
+                # drills reproduce exactly.
+                pause = 0.0
+                if not exhausted:
+                    rnd = random.Random(hash((config.seed, start, attempts)))
+                    pause = min(retry_backoff_s * 2 ** (attempts - 1), 30.0)
+                    pause *= 1.0 + 0.25 * rnd.random()
                 if telemetry is not None:
                     telemetry.emit(
-                        "retry", start=start, attempt=attempts, error=repr(e)[:200]
+                        "retry", start=start, attempt=attempts,
+                        error=repr(e)[:200], backoff_s=round(pause, 3),
                     )
-                if attempts > max_retries:
+                if exhausted:
                     raise
                 logger.exception(
-                    "batch at run %d failed (attempt %d); retrying", start, attempts
+                    "batch at run %d failed (attempt %d); retrying in %.2fs",
+                    start, attempts, pause,
                 )
+                if pause > 0:
+                    _sleep(pause)
 
     # Depth-1 pipelined batch loop: batch b+1 is dispatched (run_batch_async)
     # BEFORE batch b is finalized, so the host-side work of b — the transfer,
@@ -335,6 +456,7 @@ def run_simulation_config(
             if mesh is not None and this_batch % n_dev != 0:
                 if engine_unsharded is None:
                     engine_unsharded = Engine(config, None)
+                    engine_unsharded.chaos = chaos
                 this_engine = engine_unsharded
             else:
                 this_engine = eng
@@ -352,6 +474,8 @@ def run_simulation_config(
             else:
                 keys = this_engine.make_keys(dispatched, this_batch)
             try:
+                if chaos is not None:
+                    chaos.fire("engine.dispatch_async", start=dispatched)
                 fin = this_engine.run_batch_async(keys)
             except Exception:  # noqa: BLE001 — retried at finalize time
                 logger.exception(

@@ -125,6 +125,7 @@ def run_sweep(
     resume: bool = False,
     telemetry_path: Path | None = None,
     engine_cache: dict | None = None,
+    chaos=None,
 ) -> list[dict]:
     """Run every point; returns (and optionally appends as JSONL) result dicts.
 
@@ -148,11 +149,23 @@ def run_sweep(
     recompiling (pinned by tests/test_sweep_engine_cache.py). Defaults to a
     fresh per-call cache on the tpu backend; pass a dict to share across
     calls.
+
+    ``chaos`` (tpusim.chaos: plan, injector, or plan-JSON path) arms fault
+    injection: a ``sweep.point`` seam fires before each point (so a drill
+    can poison one named point), and the injector is threaded into the tpu
+    backend's own seams. A poisoned point fails LOUD and kills the sweep —
+    the recovery story is re-running with ``resume=True`` and WITHOUT the
+    chaos plan (a fresh process re-arms every fault count, so resuming with
+    the same plan just dies at the same point), which fills exactly the
+    missing points (tests/test_chaos.py pins the refilled rows bit-equal to
+    a fault-free sweep).
     """
     import dataclasses
 
     from .backend import get_backend
+    from .chaos import as_injector
 
+    chaos = as_injector(chaos)
     if engine_cache is None:
         engine_cache = {}
 
@@ -181,6 +194,9 @@ def run_sweep(
         from .telemetry import TelemetryRecorder
 
         recorder = TelemetryRecorder(telemetry_path)
+        if chaos is not None:
+            chaos.bind_telemetry(recorder)
+            recorder.chaos = chaos
 
     results = []
     for name, config in points:
@@ -189,10 +205,15 @@ def run_sweep(
             if not quiet:
                 print(f"[{name}] already in {out_path}; skipping")
             continue
+        if chaos is not None:
+            # The poisoned-point seam: fires before any compute so a drill
+            # costs nothing, and fails loud — an operator resumes with
+            # --resume, which fills exactly the missing points.
+            chaos.fire("sweep.point", target=name, backend=backend)
         config = dataclasses.replace(config, runs=runs)
         t0 = time.monotonic()
         if backend == "tpu":
-            kwargs = {"engine_cache": engine_cache}
+            kwargs = {"engine_cache": engine_cache, "chaos": chaos}
             if checkpoint_dir is not None:
                 checkpoint_dir.mkdir(parents=True, exist_ok=True)
                 kwargs["checkpoint_path"] = checkpoint_dir / f"{name}.npz"
@@ -270,7 +291,18 @@ def main(argv: list[str] | None = None) -> int:
         "--no-probe", action="store_true",
         help="skip the pre-flight accelerator probe (tpu backend only)",
     )
+    p.add_argument(
+        "--chaos", type=Path, metavar="PLAN",
+        help="JSON chaos plan (tpusim.chaos): deterministic fault-injection "
+        "drill across the probe, dispatch, checkpoint and telemetry seams",
+    )
     args = p.parse_args(argv)
+
+    chaos = None
+    if args.chaos is not None:
+        from .chaos import ChaosInjector, load_plan
+
+        chaos = ChaosInjector(load_plan(args.chaos))
 
     if args.list or not args.sweep:
         for name, gen in sorted(sweeps.items()):
@@ -288,7 +320,7 @@ def main(argv: list[str] | None = None) -> int:
         # multi-hour sweep at init (tpusim.probe).
         from .probe import probe_backend
 
-        platform = probe_backend()
+        platform = probe_backend(chaos=chaos)
         if platform is None:
             print(
                 "error: accelerator backend unavailable after probe retries; "
@@ -317,6 +349,7 @@ def main(argv: list[str] | None = None) -> int:
         quiet=args.quiet,
         resume=args.resume,
         telemetry_path=args.telemetry,
+        chaos=chaos,
     )
     return 0
 

@@ -38,10 +38,13 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import json
+import logging
 import time
 import uuid
 from pathlib import Path
 from typing import Any, Iterator
+
+logger = logging.getLogger("tpusim")
 
 __all__ = [
     "TelemetryRecorder",
@@ -118,12 +121,19 @@ class TelemetryRecorder:
     ``run_id``. The file handle is opened lazily and line-buffered so a
     killed process loses at most the line being written — which
     :func:`load_spans` tolerates on read-back.
+
+    Writes are best-effort by contract: a failed write (ENOSPC, a yanked
+    volume) warns once and disables the recorder for the rest of the run —
+    telemetry must never take a run down. ``chaos`` (tpusim.chaos) is the
+    fault-injection seam that drills exactly that path.
     """
 
-    def __init__(self, path: str | Path, run_id: str | None = None):
+    def __init__(self, path: str | Path, run_id: str | None = None, chaos=None):
         self.path = Path(path)
         self.run_id = run_id or new_run_id()
+        self.chaos = chaos
         self._fh = None
+        self._dead = False
 
     def emit(
         self,
@@ -135,9 +145,8 @@ class TelemetryRecorder:
     ) -> None:
         """Append one span line. ``t_start`` defaults to now (an
         instantaneous event); externally-timed spans pass their own."""
-        if self._fh is None:
-            self.path.parent.mkdir(parents=True, exist_ok=True)
-            self._fh = self.path.open("a", buffering=1)
+        if self._dead:
+            return
         row = {
             "run_id": self.run_id,
             "span": span,
@@ -145,7 +154,29 @@ class TelemetryRecorder:
             "dur_s": round(float(dur_s), 6),
             "attrs": _jsonable(attrs),
         }
-        self._fh.write(json.dumps(row) + "\n")
+        try:
+            if self.chaos is not None and span != "chaos":
+                # "chaos" spans are the injector's own ledger lines; letting
+                # a telemetry.write fault fire while recording one would
+                # recurse into a second injection. ("target", not "span": the
+                # injector reports context through emit(span="chaos", ...).)
+                self.chaos.fire("telemetry.write", target=span)
+            if self._fh is None:
+                self.path.parent.mkdir(parents=True, exist_ok=True)
+                self._fh = self.path.open("a", buffering=1)
+            self._fh.write(json.dumps(row) + "\n")
+        except OSError as e:
+            self._dead = True
+            logger.warning(
+                "telemetry write to %s failed (%s); disabling the recorder "
+                "for the rest of this run", self.path, e,
+            )
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
 
     @contextlib.contextmanager
     def span(self, name: str, **attrs: Any) -> Iterator[dict[str, Any]]:
@@ -168,9 +199,11 @@ class TelemetryRecorder:
 def load_spans(path: str | Path) -> list[dict]:
     """Read a telemetry JSONL back, skipping truncated/foreign lines (a
     killed window can cut the final line mid-write, exactly like the sweep
-    output files — same tolerance policy as the ``--resume`` scanner)."""
+    output files — same tolerance policy as the ``--resume`` scanner).
+    ``errors="replace"``: a line torn inside a multi-byte sequence must not
+    turn into a decode exception that hides every intact span before it."""
     spans = []
-    for line in Path(path).read_text().splitlines():
+    for line in Path(path).read_text(errors="replace").splitlines():
         if not line.strip():
             continue
         try:
