@@ -459,6 +459,10 @@ def main() -> int:
         info["engine"] = "pallas" if isinstance(engine, PallasEngine) else "scan"
         info["superstep"] = engine.superstep
         info["pipelined"] = not args.no_pipeline
+        # Attribution fields for future perf trajectories: which sampler
+        # path and state layout this number was measured on.
+        info["rng_batch"] = config.rng_batch
+        info["state_dtype"] = config.resolved_count_dtype
 
         phase = "headline-compile"
         # Compile + warm up (first TPU compile is slow and must not be timed).
@@ -537,6 +541,8 @@ def main() -> int:
                 "mode": exact_cfg.resolved_mode,
                 "superstep": eng2.superstep,
                 "pipelined": not args.no_pipeline,
+                "rng_batch": exact_cfg.rng_batch,
+                "state_dtype": exact_cfg.resolved_count_dtype,
             }
             t0 = time.monotonic()
             try:

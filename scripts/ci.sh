@@ -42,6 +42,47 @@ env JAX_PLATFORMS=cpu python -m tpusim report "$chaos_dir/drill.jsonl" \
   | grep -q "Fault ledger (injected chaos)"
 rm -rf "$chaos_dir"
 
+echo "== perf guard (batched RNG + packed state) =="
+# The PR-6 hot-path contracts, as a standalone leg so a regression is named
+# in CI output: (a) the default (flight_capacity=0) device-loop program
+# still carries ZERO recorder machinery with the packed/batched state
+# leaves (jaxpr program-text check — no ring tensor, no slot modulo), and
+# (b) the warmed batched-RNG dispatch paths recompile exactly never.
+env JAX_PLATFORMS=cpu python - <<'EOF'
+import jax
+from tpusim.config import SimConfig, default_network
+from tpusim.engine import Engine
+from tpusim.flight import N_FIELDS
+from tpusim.runner import make_run_keys
+from tpusim.testing import compile_count_guard
+
+cfg = SimConfig(network=default_network(), duration_ms=86_400_000, runs=8,
+                batch_size=8, chunk_steps=64)
+assert cfg.rng_batch and cfg.resolved_count_dtype == "int16", (
+    cfg.rng_batch, cfg.resolved_count_dtype)
+keys = make_run_keys(0, 0, 8)
+
+def loop_jaxpr(c):
+    eng = Engine(c)
+    hi, lo = eng._ledger_init(8)
+    return str(jax.make_jaxpr(lambda k: eng._device_loop(k, hi, lo, eng.params))(keys))
+
+import dataclasses
+off = loop_jaxpr(cfg)
+on = loop_jaxpr(dataclasses.replace(cfg, flight_capacity=7))
+marker = f"7,{N_FIELDS}]"
+assert " rem " not in off and marker not in off, "recorder leaked into cap=0 program"
+assert " rem " in on and marker in on, "recorder missing from cap>0 program"
+
+eng = Engine(cfg)
+eng.run_batch(keys)
+eng.run_batch(keys, pipelined=True)
+with compile_count_guard(exact=0):
+    eng.run_batch(keys)
+    eng.run_batch(keys, pipelined=True)
+print("perf guard: compiled-out recorder + zero warm recompiles OK")
+EOF
+
 echo "== telemetry smoke =="
 # One tiny batch end-to-end through the telemetry path: the JSONL ledger must
 # parse and `tpusim report` must render it (exit 0) — the cheapest guard

@@ -1,0 +1,248 @@
+"""Batched wide RNG generation (SimConfig.rng_batch) and packed VMEM state
+(SimConfig.state_dtype): both are pure compile-time performance knobs, pinned
+here to be observationally invisible — every statistic, counter and flight row
+is bit-identical to the legacy per-event / int32 programs, the wide xoroshiro
+draw preserves per-stream word-consumption order (the native-backend
+bit-compat contract), and the packed dtypes fail loud before they can wrap.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpusim.config import SimConfig, default_network, reference_selfish_network
+from tpusim.engine import Engine, default_n_steps
+from tpusim.runner import make_run_keys
+from tpusim.testing import compile_count_guard
+
+FAST = SimConfig(
+    network=default_network(propagation_ms=10_000),  # racy: arrivals matter
+    duration_ms=4 * 86_400_000,
+    runs=32,
+    batch_size=32,
+    chunk_steps=128,
+    seed=23,
+)
+EXACT = dataclasses.replace(
+    FAST, network=reference_selfish_network(), mode="exact", runs=16,
+    batch_size=16, superstep=2,
+)
+
+
+def _assert_sums_equal(a: dict, b: dict, msg: str) -> None:
+    assert a.keys() == b.keys()
+    for name in a:
+        np.testing.assert_array_equal(
+            np.asarray(a[name]), np.asarray(b[name]), err_msg=f"{msg}: {name}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Batched wide generation == legacy per-event draws, engine level.
+
+
+@pytest.mark.parametrize("config", [FAST, EXACT], ids=["fast", "exact-selfish"])
+def test_threefry_batched_equals_per_event(config):
+    keys = make_run_keys(config.seed, 0, config.runs)
+    legacy = Engine(dataclasses.replace(config, rng_batch=False)).run_batch(keys)
+    out = Engine(config).run_batch(keys)
+    _assert_sums_equal(legacy, out, "rng_batch")
+
+
+def test_xoroshiro_wide_equals_sequential_consumption():
+    """The K-wide lookahead must replay the conditional-advance stream order
+    exactly: rng_batch=False is the per-event path already pinned bit-equal
+    to the native backend (tests/test_xoroshiro_engine.py), so equality here
+    extends the native bit-compat contract to the wide path."""
+    config = dataclasses.replace(FAST, rng="xoroshiro", superstep=4, runs=16,
+                                 batch_size=16)
+    legacy = Engine(dataclasses.replace(config, rng_batch=False))
+    wide = Engine(config)
+    keys = legacy.make_keys(0, 16)
+    _assert_sums_equal(
+        legacy.run_batch(keys), wide.run_batch(keys), "xoroshiro wide"
+    )
+
+
+def test_next_words_wide_is_k_sequential_draws():
+    """Unit pin of the wide primitive for BOTH rngs' building blocks: K-wide
+    xoroshiro lookahead == K sequential next_words calls (words AND states),
+    and the vectorized winner maps == their scalar forms."""
+    from tpusim import xoroshiro as xo
+    from tpusim.sampling import winner_from_bits, winners_from_bits
+
+    streams = xo.seed_streams(np.arange(8, dtype=np.uint64))
+    states, his, los = xo.next_words_wide(streams, 4)
+    s = streams
+    for c in range(4):
+        s, h, l = xo.next_words(s)
+        np.testing.assert_array_equal(np.asarray(his[c]), np.asarray(h))
+        np.testing.assert_array_equal(np.asarray(los[c]), np.asarray(l))
+        for limb_wide, limb_seq in zip(states[c], s):
+            np.testing.assert_array_equal(np.asarray(limb_wide), np.asarray(limb_seq))
+
+    # select_stream_by_count: count c lands on the c-th advanced state.
+    for c in range(5):
+        sel = xo.select_stream_by_count(jnp.int32(c), streams, states)
+        want = streams if c == 0 else states[c - 1]
+        for a, b in zip(sel, want):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # Vectorized winner maps == scalar maps, word for word.
+    thr = np.cumsum([40, 30, 30]).astype(np.uint32) * ((2**32 - 1) // 100)
+    bits = jax.random.bits(jax.random.PRNGKey(0), (64,), jnp.uint32)
+    wide = winners_from_bits(bits, jnp.asarray(thr))
+    for i in range(64):
+        assert int(wide[i]) == int(winner_from_bits(bits[i], jnp.asarray(thr)))
+
+    from tpusim.sampling import winner_thresholds
+    from tpusim.xoroshiro import (
+        thresholds64_limbs,
+        winner_from_word64,
+        winners_from_words64,
+    )
+
+    t_hi, t_lo = thresholds64_limbs(winner_thresholds(np.array([40, 30, 30])))
+    thr_hi, thr_lo = jnp.asarray(t_hi), jnp.asarray(t_lo)
+    w = winners_from_words64(his, los, thr_hi, thr_lo)
+    for c in range(4):
+        for i in range(8):
+            assert int(w[c, i]) == int(
+                winner_from_word64(his[c, i], los[c, i], thr_hi, thr_lo)
+            )
+
+
+# ---------------------------------------------------------------------------
+# Packed state dtype: resolution rule, loud overflow guard, bit-equality.
+
+
+def test_count_dtype_resolution_and_overflow_guard():
+    # Short durations pack; the formula matches engine.default_n_steps.
+    assert FAST.resolved_count_dtype == "int16"
+    assert FAST.count_bound == default_n_steps(
+        FAST.duration_ms, FAST.network.block_interval_s
+    )
+    # A year-long run cannot fit int16 heights: auto WIDENS...
+    year = dataclasses.replace(FAST, duration_ms=365 * 86_400_000)
+    assert year.resolved_count_dtype == "int32"
+    # ...and an explicit int16 request FAILS LOUD instead of wrapping.
+    with pytest.raises(ValueError, match="int16"):
+        dataclasses.replace(year, state_dtype="int16")
+    # Serialization round-trips both knobs.
+    rt = SimConfig.from_json(
+        dataclasses.replace(FAST, rng_batch=False, state_dtype="int32").to_json()
+    )
+    assert rt.rng_batch is False and rt.state_dtype == "int32"
+
+
+@pytest.mark.parametrize("config", [FAST, EXACT], ids=["fast", "exact-selfish"])
+def test_packed_state_bit_equal_to_int32(config):
+    assert config.resolved_count_dtype == "int16"  # the packed regime
+    keys = make_run_keys(config.seed, 0, config.runs)
+    wide = Engine(dataclasses.replace(config, state_dtype="int32")).run_batch(keys)
+    packed = Engine(config).run_batch(keys)
+    _assert_sums_equal(wide, packed, "state_dtype")
+
+
+def test_packed_state_scan_vs_pallas_bit_equal():
+    from tpusim.pallas_engine import PallasEngine
+
+    config = dataclasses.replace(
+        EXACT, runs=128, batch_size=128, duration_ms=2 * 86_400_000,
+        flight_capacity=512,
+    )
+    assert config.resolved_count_dtype == "int16"
+    keys = make_run_keys(config.seed, 0, config.runs)
+    scan = Engine(config).run_batch(keys)
+    pallas = PallasEngine(
+        config, tile_runs=128, step_block=32, interpret=True
+    ).run_batch(keys)
+    _assert_sums_equal(scan, pallas, "packed scan-vs-pallas")
+
+
+def test_packed_state_checkpoint_resumes_across_dtypes(tmp_path):
+    """rng_batch/state_dtype are NOT sampling identity: a checkpoint written
+    by the packed batched engine must resume under the legacy knobs with
+    bit-identical statistics."""
+    from tpusim.runner import run_simulation_config
+
+    ck = tmp_path / "ck.npz"
+    small = dataclasses.replace(FAST, runs=16, batch_size=8, duration_ms=86_400_000)
+    partial = dataclasses.replace(small, runs=8)
+    run_simulation_config(partial, checkpoint_path=ck)
+    resumed = run_simulation_config(
+        dataclasses.replace(small, rng_batch=False, state_dtype="int32"),
+        checkpoint_path=ck,
+    )
+    direct = run_simulation_config(small)
+    for mr, md in zip(resumed.miners, direct.miners):
+        assert mr.blocks_found_mean == md.blocks_found_mean
+        assert mr.stale_rate_mean == md.stale_rate_mean
+
+
+# ---------------------------------------------------------------------------
+# Small-batch Pallas grid: the auto tile shrinks so the kernel still runs.
+
+
+def test_pallas_auto_tile_serves_small_batches():
+    from tpusim.pallas_engine import FAST_TILE_RUNS, PallasEngine
+
+    config = SimConfig(
+        network=default_network(propagation_ms=10_000),
+        duration_ms=86_400_000, runs=256, batch_size=256, mode="fast",
+        chunk_steps=64, seed=7,
+    )
+    eng = PallasEngine(config, step_block=32, interpret=True)
+    assert eng.tile_runs == 256 < FAST_TILE_RUNS
+    keys = make_run_keys(7, 0, 256)
+    _assert_sums_equal(
+        Engine(config).run_batch(keys), eng.run_batch(keys), "small batch"
+    )
+    # An explicit tile_runs is never overridden.
+    assert PallasEngine(config, tile_runs=128, step_block=32,
+                        interpret=True).tile_runs == 128
+
+
+# ---------------------------------------------------------------------------
+# Compile hygiene: the batched programs compile once and the recorder-less
+# program still carries no flight machinery with the new state leaves.
+
+
+def test_batched_dispatch_compiles_once_warm():
+    engine = Engine(FAST)
+    keys = make_run_keys(FAST.seed, 0, FAST.runs)
+    engine.run_batch(keys)  # warm the device loop
+    engine.run_batch(keys, pipelined=True)  # warm the pipelined chunk program
+    with compile_count_guard(exact=0):
+        engine.run_batch(keys)
+        engine.run_batch(keys, pipelined=True)
+
+
+def test_flight_capacity_zero_still_compiles_out():
+    """The jaxpr program-text pin from tests/test_flight.py, re-asserted on
+    the NEW state leaves (packed int16 counts, dropped honest-roster
+    n_private/bhp, precomputed draws): no (C, N_FIELDS) ring tensor and no
+    ``rem`` op in the default (cap=0, batched, packed) device-loop program,
+    and the ring marker appears the moment capacity is nonzero."""
+    from tpusim.flight import N_FIELDS
+
+    base = dataclasses.replace(FAST, runs=8, batch_size=8)
+    keys = make_run_keys(base.seed, 0, 8)
+
+    def loop_jaxpr(config):
+        eng = Engine(config)
+        hi, lo = eng._ledger_init(8)
+        return str(
+            jax.make_jaxpr(lambda k: eng._device_loop(k, hi, lo, eng.params))(keys)
+        )
+
+    off = loop_jaxpr(base)
+    on = loop_jaxpr(dataclasses.replace(base, flight_capacity=7))
+    marker = f"7,{N_FIELDS}]"
+    assert " rem " not in off and marker not in off
+    assert " rem " in on and marker in on

@@ -93,13 +93,20 @@ def test_resolve_superstep_rules():
     assert resolve_superstep(4, 128) == 4
     with pytest.raises(ValueError, match="superstep"):
         resolve_superstep(3, 128)
-    # Auto halves down to a divisor; any 64-aligned budget takes the default.
-    from tpusim.engine import DEFAULT_SUPERSTEP
+    # Auto comes from the measured per-platform table and halves down to a
+    # divisor; any 64-aligned budget takes the table value unreduced.
+    from tpusim.engine import AUTO_SUPERSTEP_TABLE, auto_superstep
 
-    assert resolve_superstep(None, 192) == DEFAULT_SUPERSTEP
+    assert resolve_superstep(None, 192) == auto_superstep(exact=False)
+    assert resolve_superstep(None, 192, exact=True) == auto_superstep(exact=True)
     assert resolve_superstep(None, 4) in (1, 2, 4)
     assert 4 % resolve_superstep(None, 4) == 0
     assert resolve_superstep(None, 1) == 1
+    # The table is the documented re-tune surface: every entry is a power of
+    # two (so halving always terminates at a divisor) for a known platform.
+    for (platform, kind), k in AUTO_SUPERSTEP_TABLE.items():
+        assert platform in ("cpu", "tpu", "gpu") and kind in ("fast", "exact")
+        assert k >= 1 and (k & (k - 1)) == 0
 
 
 def test_superstep_serializes_and_stays_out_of_fingerprint(tmp_path):

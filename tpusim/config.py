@@ -172,6 +172,30 @@ class SimConfig:
     #: programs identical to a recorder-less build. NOT part of the sampling
     #: identity: recording is purely observational.
     flight_capacity: int = 0
+    #: Batched wide RNG generation (the tfp.mcmc discipline of vectorizing
+    #: the *sampler*, not the loop around it). True (default): the threefry
+    #: engines map a chunk's whole (steps, 2) word block to (winner,
+    #: interval) draws in ONE vectorized pass before the event loop, and the
+    #: xoroshiro path pre-advances both per-run streams K (= superstep) words
+    #: per loop iteration, each event selecting its draw by consumption count
+    #: — the per-stream word-consumption ORDER is unchanged, so results are
+    #: bit-identical to the per-event path and the xoroshiro mode stays
+    #: bit-compatible with the native backend. False restores the legacy
+    #: per-event draw mapping (kept for A/B timing and bisection). A pure
+    #: compile-time performance knob: NOT part of the sampling identity or
+    #: checkpoint fingerprint.
+    rng_batch: bool = True
+    #: Packed-state dtype for the block-COUNT state leaves (heights, stale,
+    #: group counts, the consensus count tensors): "auto" (default) packs
+    #: them as int16 whenever the per-run Poisson event bound provably fits
+    #: (see ``resolved_count_dtype``), halving the scan carry's HBM
+    #: round-trip and the Pallas kernel's VMEM residency for those leaves;
+    #: "int32" forces the wide layout; "int16" forces packing and FAILS LOUD
+    #: (ValueError) when the duration-derived bound does not fit. Time leaves
+    #: (clocks, arrivals) always stay int32 — they span 2^30. Values are
+    #: identical either way (all arithmetic stays in range), so the dtype is
+    #: NOT part of the sampling identity or checkpoint fingerprint.
+    state_dtype: str = "auto"
 
     def __post_init__(self) -> None:
         if self.duration_ms <= 0:
@@ -190,6 +214,17 @@ class SimConfig:
             raise ValueError("superstep must be >= 1 (or None for auto)")
         if self.flight_capacity < 0:
             raise ValueError("flight_capacity must be >= 0 (0 disables recording)")
+        if self.state_dtype not in ("auto", "int32", "int16"):
+            raise ValueError(
+                f"state_dtype must be auto|int32|int16, got {self.state_dtype!r}"
+            )
+        if self.state_dtype == "int16" and not self._count_bound_fits_int16:
+            raise ValueError(
+                f"state_dtype='int16' requested but the per-run event bound "
+                f"({self.count_bound}) exceeds int16 at duration_ms="
+                f"{self.duration_ms}; use 'auto' (widens to int32) or shorten "
+                f"the duration"
+            )
         # 32-bit time-arithmetic envelope (see tpusim.state docstring): one
         # interval draw must stay far below INTERVAL_CAP = 2^27 ms, and
         # propagation delays below one chunk re-base span.
@@ -219,6 +254,38 @@ class SimConfig:
         if self.group_slots is not None:
             return self.group_slots
         return 2
+
+    @property
+    def count_bound(self) -> int:
+        """Upper bound on ANY block-count state value one run can reach: the
+        per-run event-loop bound (found + arrival events at mean + 8 sigma of
+        the Poisson block count, engine.default_n_steps) — every height /
+        group count / consensus-tensor entry is at most the run's total block
+        count, which is at most half this, and the ``stale`` counter's
+        pathological multi-count geometries stay well inside the remaining
+        2x headroom (a popped block can only be re-popped after a
+        re-adoption, a ~race_ratio^2 event per block).
+
+        Same formula as ``engine.default_n_steps`` (kept inline so this
+        module stays jax-free; pinned equal by tests/test_rng_batch.py)."""
+        import math
+
+        mu = self.duration_ms / (self.network.block_interval_s * 1000.0)
+        return int(2.0 * (mu + 8.0 * math.sqrt(mu + 1.0))) + 16
+
+    @property
+    def _count_bound_fits_int16(self) -> bool:
+        return self.count_bound <= 2**15 - 1
+
+    @property
+    def resolved_count_dtype(self) -> str:
+        """The dtype actually compiled for the block-count state leaves:
+        ``state_dtype`` unless "auto", which packs to int16 exactly when
+        :attr:`count_bound` fits (~106 days at the 600 s reference interval)
+        and widens to int32 otherwise."""
+        if self.state_dtype != "auto":
+            return self.state_dtype
+        return "int16" if self._count_bound_fits_int16 else "int32"
 
     @property
     def resolved_mode(self) -> str:
@@ -259,6 +326,8 @@ def _config_to_dict(cfg: SimConfig) -> dict[str, Any]:
         "superstep": cfg.superstep,
         "rng": cfg.rng,
         "flight_capacity": cfg.flight_capacity,
+        "rng_batch": cfg.rng_batch,
+        "state_dtype": cfg.state_dtype,
     }
 
 
@@ -289,4 +358,8 @@ def _config_from_dict(d: dict[str, Any]) -> SimConfig:
         kwargs["flight_capacity"] = int(d["flight_capacity"])
     if "rng" in d:
         kwargs["rng"] = str(d["rng"])
+    if "rng_batch" in d:
+        kwargs["rng_batch"] = bool(d["rng_batch"])
+    if "state_dtype" in d:
+        kwargs["state_dtype"] = str(d["state_dtype"])
     return SimConfig(network=network, **kwargs)

@@ -31,6 +31,13 @@ execution order and of how runs are batched, replacing the reference's two
 per-run xoroshiro streams (main.cpp:131-134). ``chunk_steps`` IS part of the
 sampling identity (it sets the step->key mapping), which is why it is
 serialized with the config and covered by the checkpoint fingerprint.
+Under the default ``SimConfig.rng_batch`` the *mapping* of those words —
+winner index from the threshold compare, interval ms from the exponential —
+is also hoisted out of the event loop into one vectorized pass per chunk
+(and, for rng="xoroshiro", into a K-wide consumption-order-preserving
+lookahead per superstep), so the serial scan body consumes finished draws;
+the words, their per-event assignment and every statistic are bit-identical
+to the per-event mapping (tests/test_rng_batch.py).
 """
 
 from __future__ import annotations
@@ -63,7 +70,7 @@ from .state import (
 
 __all__ = [
     "Engine", "SimCounters", "default_n_steps", "resolve_superstep",
-    "DEFAULT_SUPERSTEP", "DEPTH_BUCKETS",
+    "auto_superstep", "AUTO_SUPERSTEP_TABLE", "DEPTH_BUCKETS",
 ]
 
 logger = logging.getLogger("tpusim")
@@ -71,23 +78,55 @@ logger = logging.getLogger("tpusim")
 #: Per-batch int32 block-count sums stay exact below this many blocks.
 _I32_SUM_GUARD = 2**31 - 1
 
-#: Auto superstep width K: events unrolled per scan step / kernel loop
-#: iteration. Measured on this container's 2-core CPU (scripts/roofline.py
-#: K-ablation, medians of repeated 45d batches): fast mode is ~15-25% faster
-#: at K=2 than K=1 at the bench batch sizes while K>=4 regresses (the
-#: unrolled body spills); exact mode regresses at every K>1 (its step is
-#: already compute-heavy), so its auto default stays 1. Powers of two <= 64
-#: always divide the 64-aligned auto chunk_steps and the Pallas step_block.
-DEFAULT_SUPERSTEP = 2
-DEFAULT_SUPERSTEP_EXACT = 1
+#: Auto superstep width K (events unrolled per scan step / kernel loop
+#: iteration), as a MEASURED table keyed by (jax backend platform, mode
+#: kind) instead of one hard-coded constant: the K x batch ablation of
+#: `scripts/roofline.py --k-list 1,2,4,8,16` (chained-chunk min-of-repeats,
+#: the repo's only sanctioned kernel timing) is the measurement path, and
+#: each entry names the artifact it came from. Re-tune by re-running the
+#: ablation on the target platform and editing the row — resolve_superstep
+#: halves a table value until it divides the step budget, so entries can
+#: assume the 64-aligned auto chunk_steps / Pallas step_block.
+AUTO_SUPERSTEP_TABLE: dict[tuple[str, str], int] = {
+    # This container's 2-core CPU, batched-RNG engine (PR 6 ablation,
+    # artifacts/roofline_cpu.json --k-list 1,2,4,8,16): fast mode peaks at
+    # K=2 at the production batches (636k ev/s vs 599k at K=1 at batch 256
+    # int32; K>=4 regresses) — only the small batch-64 cell prefers K=1 —
+    # and exact mode regresses at every K>1 (160k at K=1 vs 127k at K=2,
+    # batch 256; the headline A/B at 512 runs agrees, 8.1 s vs 12+ s).
+    ("cpu", "fast"): 2,
+    ("cpu", "exact"): 1,
+    # v5e round-5 on-chip ablation (artifacts/perf_tpu.jsonl): fast kernel
+    # peaks at K=2; exact regresses above 1. Pre-batched-RNG numbers — the
+    # on-TPU retune rides the next-TPU-window checklist (ROADMAP).
+    ("tpu", "fast"): 2,
+    ("tpu", "exact"): 1,
+}
+
+#: Fallback for platforms with no measured row (e.g. gpu): the historical
+#: defaults, conservative on the side of the pre-PR-6 measurements.
+_AUTO_SUPERSTEP_FALLBACK = {"fast": 2, "exact": 1}
+
+
+def auto_superstep(exact: bool, platform: str | None = None) -> int:
+    """The measured auto-K for this platform and mode kind (table above).
+    ``platform`` defaults to the active jax backend — resolved lazily, at
+    engine-construction time, so importing this module never initializes an
+    XLA backend (worker processes must call jax.distributed.initialize
+    first)."""
+    if platform is None:
+        platform = jax.default_backend()
+    kind = "exact" if exact else "fast"
+    return AUTO_SUPERSTEP_TABLE.get((platform, kind), _AUTO_SUPERSTEP_FALLBACK[kind])
 
 
 def resolve_superstep(requested: int | None, divisor: int, *, exact: bool = False) -> int:
     """The unroll width actually compiled: an explicit request must divide
     ``divisor`` (chunk_steps for the scan engine, step_block for the Pallas
     kernel) exactly — a silent trim would compile a different program than
-    the one asked for; the auto default halves itself until it divides (K=1
-    always does)."""
+    the one asked for; the auto default (the measured per-platform table of
+    :func:`auto_superstep`) halves itself until it divides (K=1 always
+    does)."""
     if requested is not None:
         if divisor % requested:
             raise ValueError(
@@ -95,7 +134,7 @@ def resolve_superstep(requested: int | None, divisor: int, *, exact: bool = Fals
                 f"chunk_steps / step_block)"
             )
         return requested
-    k = DEFAULT_SUPERSTEP_EXACT if exact else DEFAULT_SUPERSTEP
+    k = auto_superstep(exact)
     while divisor % k:
         k //= 2
     return max(k, 1)
@@ -155,7 +194,9 @@ def _count_step(ctr: SimCounters, old: SimState, new: SimState, cap: jax.Array) 
     moves in the notify reorg, so ``new.stale - old.stale`` is exactly the
     per-miner pop count of this event's adoptions (zero when the sweep is
     gated off or the run is frozen)."""
-    d = new.stale - old.stale
+    # int32 regardless of the packed count dtype: the counter leaves stay
+    # wide (active_steps alone outgrows int16 within a run).
+    d = (new.stale - old.stale).astype(jnp.int32)
     dmax = jnp.max(d)
     bucket = jnp.minimum(dmax, DEPTH_BUCKETS) - 1
     return SimCounters(
@@ -404,6 +445,13 @@ class Engine:
         )
         any_selfish = self.any_selfish
         K = self.superstep
+        # Packed-state count dtype (int16 when the duration-derived bound
+        # provably fits — config.resolved_count_dtype) and the batched-RNG
+        # toggle: both pure compile-time knobs, results bit-identical.
+        from .state import COUNT_DTYPES
+
+        self.count_dtype = cdt = COUNT_DTYPES[config.resolved_count_dtype]
+        rng_batch = config.rng_batch
         # Flight recorder (tpusim.flight): a trace-time constant. 0 means the
         # recorder leaves are never created and no recording op is traced —
         # the jitted programs are identical to a recorder-less build (pinned
@@ -416,10 +464,17 @@ class Engine:
 
         if xoro:
             from .state import INTERVAL_CAP
-            from .xoroshiro import interval_ms_from_word, next_words, unpack_run_streams
+            from .xoroshiro import (
+                interval_ms_from_word,
+                next_words,
+                next_words_wide,
+                select_stream_by_count,
+                unpack_run_streams,
+                winners_from_words64,
+            )
 
             def init_fn(packed: jax.Array, params: SimParams):
-                state = init_state(m, k, exact)
+                state = init_state(m, k, exact, cdt, any_selfish)
                 xi, xw = unpack_run_streams(packed)
                 # Initial next-block draw from the interval stream, like the
                 # native loop's pre-loop draw (simcore simulate_run).
@@ -439,7 +494,43 @@ class Engine:
             ):
                 ctr, xi, xw, fr = aux
 
-                def body(carry, _):
+                def body_wide(carry, _):
+                    # Batched wide generation (rng_batch): pre-advance both
+                    # sequential streams K words, map ALL K candidate
+                    # (winner, interval) pairs in one vectorized pass, and
+                    # let each unrolled event select its draw by consumption
+                    # count — word c goes to the c-th CONSUMED draw, exactly
+                    # the conditional-advance order of the per-event path
+                    # (and of the native backend), so results stay
+                    # bit-compatible. The final stream state is the
+                    # consumed-count-th lookahead state.
+                    st, xi, xw, ctr, fr = carry
+                    wstates, wh, wl = next_words_wide(xw, K)
+                    istates, ih, il = next_words_wide(xi, K)
+                    w_cand = winners_from_words64(
+                        wh, wl, params.thr64_hi, params.thr64_lo
+                    )
+                    dt_cand = interval_ms_from_word(
+                        ih, il, params.mean_interval_ms, float(INTERVAL_CAP)
+                    )
+                    consumed = jnp.zeros((), jnp.int32)
+                    kidx = jnp.arange(K)
+                    for _j in range(K):
+                        prev = st
+                        found_due = (st.t < cap) & (st.t == st.next_block_time)
+                        sel = kidx == consumed
+                        w = jnp.sum(jnp.where(sel, w_cand, 0), dtype=jnp.int32)
+                        dt = jnp.sum(jnp.where(sel, dt_cand, 0), dtype=jnp.int32)
+                        st, fr = _step_event(
+                            st, w, dt, params, cap, any_selfish, fr=fr
+                        )
+                        consumed = consumed + found_due.astype(jnp.int32)
+                        ctr = _count_step(ctr, prev, st, cap)
+                    xi = select_stream_by_count(consumed, xi, istates)
+                    xw = select_stream_by_count(consumed, xw, wstates)
+                    return (st, xi, xw, ctr, fr), None
+
+                def body_seq(carry, _):
                     st, xi, xw, ctr, fr = carry
                     for _j in range(K):
                         prev = st
@@ -450,16 +541,18 @@ class Engine:
                     return (st, xi, xw, ctr, fr), None
 
                 (state, xi, xw, ctr, fr), _ = jax.lax.scan(
-                    body, (state, xi, xw, ctr, fr), None, length=steps // K
+                    body_wide if rng_batch else body_seq,
+                    (state, xi, xw, ctr, fr), None, length=steps // K,
                 )
                 state, elapsed = rebase(state)
                 if fr is not None:
                     fr = _flight.advance_base(fr, elapsed)
                 return state, (ctr, xi, xw, fr), elapsed
         else:
+            from .sampling import winners_from_bits
 
             def init_fn(run_key: jax.Array, params: SimParams):
-                state = init_state(m, k, exact)
+                state = init_state(m, k, exact, cdt, any_selfish)
                 bits = jax.random.bits(jax.random.fold_in(run_key, 0), (2,), jnp.uint32)
                 # None recorder slot = empty pytree: see the xoroshiro twin.
                 fr = _flight.init_recorder(fcap) if fcap else None
@@ -473,21 +566,49 @@ class Engine:
             ):
                 ctr, fr = aux
                 key = jax.random.fold_in(run_key, 1 + chunk_idx)
-                # The (steps, 2) word block reshaped to (steps/K, K, 2): scan
-                # step s row j is word pair s*K + j — the same per-event
+                # The (steps, 2) word block reshaped to (steps/K, K, ...):
+                # scan step s row j is word pair s*K + j — the same per-event
                 # mapping as K=1, just consumed K events at a time.
                 bits = jax.random.bits(key, (steps, 2), jnp.uint32)
-                bits = bits.reshape(steps // K, K, 2)
+                if rng_batch:
+                    # Batched wide generation (rng_batch): the whole chunk's
+                    # sampler output — winner index and interval ms — is
+                    # mapped from the word block in ONE vectorized pass (the
+                    # tfp.mcmc discipline of vectorizing the sampler), so
+                    # the serial event loop consumes precomputed draws
+                    # instead of re-deriving them per event. Same words,
+                    # same elementwise maps: bit-identical to the per-event
+                    # path.
+                    w_all = winners_from_bits(bits[:, 0], params.thresholds)
+                    dt_all = interval_from_bits(bits[:, 1], params.mean_interval_ms)
+                    xs = (
+                        w_all.reshape(steps // K, K),
+                        dt_all.reshape(steps // K, K),
+                    )
 
-                def body(carry, xs: jax.Array):
-                    st, ctr, fr = carry
-                    for j in range(K):
-                        prev = st
-                        st, fr = _step(st, xs[j], params, cap, any_selfish, fr)
-                        ctr = _count_step(ctr, prev, st, cap)
-                    return (st, ctr, fr), None
+                    def body(carry, x):
+                        st, ctr, fr = carry
+                        wk, dtk = x
+                        for j in range(K):
+                            prev = st
+                            st, fr = _step_event(
+                                st, wk[j], dtk[j], params, cap, any_selfish, fr=fr
+                            )
+                            ctr = _count_step(ctr, prev, st, cap)
+                        return (st, ctr, fr), None
 
-                (state, ctr, fr), _ = jax.lax.scan(body, (state, ctr, fr), bits)
+                else:
+                    xs = bits.reshape(steps // K, K, 2)
+
+                    def body(carry, x):
+                        st, ctr, fr = carry
+                        for j in range(K):
+                            prev = st
+                            st, fr = _step(st, x[j], params, cap, any_selfish, fr)
+                            ctr = _count_step(ctr, prev, st, cap)
+                        return (st, ctr, fr), None
+
+                (state, ctr, fr), _ = jax.lax.scan(body, (state, ctr, fr), xs)
                 state, elapsed = rebase(state)
                 if fr is not None:
                     fr = _flight.advance_base(fr, elapsed)
@@ -672,7 +793,8 @@ class Engine:
         return (
             type(self).__name__, self.n_miners, c.resolved_group_slots,
             self.exact, self.any_selfish, self.chunk_steps, self.superstep,
-            self.max_chunks, c.rng, c.flight_capacity, mesh_id,
+            self.max_chunks, c.rng, c.flight_capacity, c.rng_batch,
+            c.resolved_count_dtype, mesh_id,
         )
 
     def rebind(self, config: SimConfig, key: tuple) -> "Engine":

@@ -112,9 +112,26 @@ def _leaf_shapes(m: int, k: int, exact: bool) -> list[tuple[int, ...]]:
     return [(1,), (1,), (m,), (m,), (m,), (m, k), (m, k), (m, m), (m, m), (m,), (1,)]
 
 
+#: Which state leaves carry block COUNTS (packed to int16 when
+#: SimConfig.resolved_count_dtype says the bound fits) vs times/diagnostics
+#: (always int32). Parallel to _FAST_LEAVES / _EXACT_LEAVES.
+_COUNT_LEAVES = frozenset(
+    {"bhp", "height", "npriv", "stale", "gcnt", "cp", "ocp", "oin", "ocnt"}
+)
+
+
+def _leaf_dtypes(m: int, k: int, exact: bool, count_dtype) -> list:
+    """Per-leaf dtypes parallel to :func:`_leaf_shapes` — the packed-state
+    authority shared by the kernel's out_shape list and the roofline traffic
+    model (profiling.state_bytes_per_run)."""
+    names = _EXACT_LEAVES if exact else _FAST_LEAVES
+    return [count_dtype if n in _COUNT_LEAVES else I32 for n in names]
+
+
 def _make_kernel(
     *, exact: bool, any_selfish: bool, sb: int, mean_interval_ms: float,
-    n_state: int, superstep: int = 1, flight_capacity: int = 0
+    n_state: int, superstep: int = 1, flight_capacity: int = 0,
+    rng_batch: bool = True, count_dtype=I32
 ):
     """Build the step-block kernel for one mode. Ref order: bits, cap, lo,
     hi, prop, selfish, then ``n_state`` input state refs (HBM-aliased to the
@@ -122,8 +139,18 @@ def _make_kernel(
     copies). ``superstep`` events are unrolled per fori_loop iteration —
     event e still reads bits row e, so draws (and results) are identical for
     every width. ``flight_capacity`` > 0 appends the event-recorder leaves
-    and the per-step ring writes (tpusim.flight row semantics, runs-last)."""
+    and the per-step ring writes (tpusim.flight row semantics, runs-last).
+
+    ``rng_batch`` (SimConfig.rng_batch): the streamed ``bits`` block holds
+    PRE-MAPPED int32 (winner index, interval ms) rows — the host hoisted the
+    threshold compares and the log1p out of the kernel into one vectorized
+    pass per chunk — so the per-event sampler work shrinks to a single
+    one-hot compare. False streams the raw uint32 threefry words and maps
+    them per event (the legacy path). ``count_dtype`` (int16 when the
+    packed-state bound fits) types every _COUNT_LEAVES ref and all count
+    arithmetic; values are identical, the VMEM residency halves."""
     fcap = flight_capacity
+    cdt = count_dtype
 
     def kernel(bits_ref, cap_ref, lo_ref, hi_ref, prop_ref, selfish_ref, *state_refs):
         ins, outs = state_refs[:n_state], state_refs[n_state:]
@@ -188,7 +215,7 @@ def _make_kernel(
             onehot_wr = (kidx == write_idx[:, None, :]) & do[:, None, :]
             garr = jnp.where(onehot_wr, arrival[:, None, :], garr)
             accum = (merge | overflowed)[:, None, :]
-            cnt3 = jnp.broadcast_to(count, merge.shape)[:, None, :]
+            cnt3 = jnp.broadcast_to(count.astype(cdt), merge.shape)[:, None, :]
             gcnt = jnp.where(onehot_wr, jnp.where(accum, gcnt + cnt3, cnt3), gcnt)
             return garr, gcnt, jnp.sum(overflowed.astype(I32), axis=0, keepdims=True)
 
@@ -205,7 +232,7 @@ def _make_kernel(
             w0 = do & (~e0 | (merge & ~e1))
             w1 = do & e0 & (e1 | ~merge)
             accum = merge | overflowed
-            cnt = jnp.broadcast_to(count, merge.shape)
+            cnt = jnp.broadcast_to(count.astype(cdt), merge.shape)
             a0 = jnp.where(w0, arrival, a0)
             c0 = jnp.where(w0, jnp.where(accum, c0 + cnt, cnt), c0)
             a1 = jnp.where(w1, arrival, a1)
@@ -222,23 +249,36 @@ def _make_kernel(
             told = t
             old_garr = st["garr"]
 
-            bw = bits_ref[s, 0, :][None, :]  # (1, R) uint32
-            bi = bits_ref[s, 1, :][None, :]
-
             active = t < cap  # (1, R)
             found_due = active & (t == nbt)
-            # Winner one-hot straight from the cumulative thresholds
-            # (simulation.h:213-221): miner m wins iff lo[m] <= u < hi[m];
-            # the last interval is closed on the right, clamping the ~96/2^32
-            # overflow draws to the last miner exactly like winner_from_bits.
-            is_last = midx == m - 1  # (M, 1)
-            ow = (bw >= lo) & ((bw < hi) | is_last) & found_due  # (M, R)
-            owi = ow.astype(I32)
-            # Interval draw (simulation.h:205-210 semantics, tpusim.sampling).
-            # Mosaic has no uint32->float32 cast; after >>8 the value fits in
-            # 24 bits, so the int32 detour is exact.
-            u = (bi >> U32(8)).astype(I32).astype(jnp.float32) * jnp.float32(2.0**-24)
-            dt = jnp.minimum(-jnp.log1p(-u) * jnp.float32(mean_interval_ms), icap).astype(I32)
+            if rng_batch:
+                # Batched wide generation: the (winner, interval) mapping ran
+                # once per chunk on the host side of the kernel boundary —
+                # the streamed rows are already int32 (index, ms) draws, so
+                # the per-event sampler work is ONE equality compare against
+                # the miner iota (and the per-step log1p is gone from the
+                # VPU's critical path entirely).
+                wq = bits_ref[s, 0, :][None, :]  # (1, R) winner index
+                dt = bits_ref[s, 1, :][None, :]  # (1, R) interval ms
+                ow = (midx == wq) & found_due  # (M, R)
+            else:
+                bw = bits_ref[s, 0, :][None, :]  # (1, R) uint32
+                bi = bits_ref[s, 1, :][None, :]
+                # Winner one-hot straight from the cumulative thresholds
+                # (simulation.h:213-221): miner m wins iff lo[m] <= u < hi[m];
+                # the last interval is closed on the right, clamping the
+                # ~96/2^32 overflow draws to the last miner exactly like
+                # winner_from_bits.
+                is_last = midx == m - 1  # (M, 1)
+                ow = (bw >= lo) & ((bw < hi) | is_last) & found_due  # (M, R)
+                # Interval draw (simulation.h:205-210, tpusim.sampling).
+                # Mosaic has no uint32->float32 cast; after >>8 the value
+                # fits in 24 bits, so the int32 detour is exact.
+                u = (bi >> U32(8)).astype(I32).astype(jnp.float32) * jnp.float32(2.0**-24)
+                dt = jnp.minimum(
+                    -jnp.log1p(-u) * jnp.float32(mean_interval_ms), icap
+                ).astype(I32)
+            owi = ow.astype(cdt)
 
             # --- FoundBlock (simulation.h:62-76). In both modes a find
             # moves only the (M, R) own-count vector (tpusim.state
@@ -249,23 +289,23 @@ def _make_kernel(
                 npriv, bhp, cp = st["npriv"], st["bhp"], st["cp"]
                 if any_selfish:
                     sel_w = jnp.any(ow & selfish, axis=0, keepdims=True)  # (1, R)
-                    npriv_w = jnp.sum(npriv * owi, axis=0, keepdims=True)
-                    height_w = jnp.sum(height * owi, axis=0, keepdims=True)
+                    npriv_w = jnp.sum(npriv * owi, axis=0, keepdims=True, dtype=cdt)
+                    height_w = jnp.sum(height * owi, axis=0, keepdims=True, dtype=cdt)
                     is_race = sel_w & (npriv_w == 1) & (bhp == height_w)
                     private_append = sel_w & ~is_race
                     push_do = ow & ~private_append
-                    push_count = jnp.where(is_race, I32(2), I32(1))  # (1, R)
+                    push_count = jnp.where(is_race, 2, 1).astype(cdt)  # (1, R)
                     npriv = npriv + jnp.where(
                         ow,
-                        jnp.where(private_append, I32(1), jnp.where(is_race, I32(-1), I32(0))),
-                        I32(0),
-                    )
+                        jnp.where(private_append, 1, jnp.where(is_race, -1, 0)),
+                        0,
+                    ).astype(cdt)
                 else:
                     push_do = ow
-                    push_count = I32(1)
+                    push_count = jnp.ones((), cdt)
             else:
                 push_do = ow
-                push_count = I32(1)
+                push_count = jnp.ones((), cdt)
 
             arrival = t + prop  # (M, R)
             if split2:
@@ -306,8 +346,8 @@ def _make_kernel(
                 sel = kidx[:, None, :, :] == (kidx[:, :, None, :] + n_f[:, None, None, :])
                 garr = jnp.sum(jnp.where(sel, garr[:, None, :, :], 0), axis=2)
                 garr = jnp.where(jnp.any(sel, axis=2), garr, inf)
-                gcnt = jnp.sum(jnp.where(sel, gcnt[:, None, :, :], 0), axis=2)
-                unarrived = jnp.sum(gcnt, axis=1)
+                gcnt = jnp.sum(jnp.where(sel, gcnt[:, None, :, :], 0), axis=2, dtype=cdt)
+                unarrived = jnp.sum(gcnt, axis=1, dtype=cdt)
 
             # Best published chain, first-seen tiebreak (main.cpp:68-82).
             pub = height - unarrived  # (M, R)
@@ -321,7 +361,7 @@ def _make_kernel(
             # First true along the miner axis without a cumsum.
             first_idx = jnp.min(jnp.where(winners_b, midx, m), axis=0, keepdims=True)
             onehot_b = midx == first_idx  # (M, R)
-            b32 = onehot_b.astype(I32)
+            b32 = onehot_b.astype(cdt)
 
             if exact and any_selfish:
                 # --- Selfish reveal (simulation.h:149-174), before reorg.
@@ -343,27 +383,27 @@ def _make_kernel(
             # --- Reorg (simulation.h:124-142): adopt when strictly longer
             # than the full local chain (private blocks included).
             adopt = (best_h > height) & do  # (M, R)
-            unpub_b = jnp.sum(height * b32, axis=0, keepdims=True) - best_h  # (1, R)
+            unpub_b = jnp.sum(height * b32, axis=0, keepdims=True, dtype=cdt) - best_h  # (1, R)
 
             # Shared diagonal corrections (tpusim.state.notify): ocnt is the
             # authority for every stale diagonal read.
             ocp, oin = st["ocp"], st["oin"]
-            cnt_b = jnp.sum(ocnt * b32, axis=0, keepdims=True)  # (1, R)
+            cnt_b = jnp.sum(ocnt * b32, axis=0, keepdims=True, dtype=cdt)  # (1, R)
             if exact:
                 # Exact ocp is stored transposed ([j, i], see _EXACT_LEAVES);
                 # own_cp[:, b] is its b-th plane.
-                oc_b = jnp.sum(ocp * b32[:, None, :], axis=0)  # (M, R)
+                oc_b = jnp.sum(ocp * b32[:, None, :], axis=0, dtype=cdt)  # (M, R)
             else:
-                oc_b = jnp.sum(ocp * b32[None, :, :], axis=1)  # (M, R) own_cp[:, b]
-            oc_bb = jnp.sum(oc_b * b32, axis=0, keepdims=True)
+                oc_b = jnp.sum(ocp * b32[None, :, :], axis=1, dtype=cdt)  # (M, R) own_cp[:, b]
+            oc_bb = jnp.sum(oc_b * b32, axis=0, keepdims=True, dtype=cdt)
             oc_b = oc_b + b32 * (cnt_b - oc_bb)
             # Own blocks above lca(:, b) — reorg stale accounting. The
             # per-miner pop count also feeds the telemetry counters below,
             # exactly like the scan engine's stale delta (engine._count_step).
             d_stale = jnp.where(adopt, ocnt - oc_b, 0)
             stale = stale + d_stale
-            row_b = jnp.sum(oin * b32[:, None, :], axis=0)  # (M, R) own_in[b, :]
-            row_bb = jnp.sum(row_b * b32, axis=0, keepdims=True)
+            row_b = jnp.sum(oin * b32[:, None, :], axis=0, dtype=cdt)  # (M, R) own_in[b, :]
+            row_bb = jnp.sum(row_b * b32, axis=0, keepdims=True, dtype=cdt)
             row_b = row_b + b32 * (cnt_b - row_bb)
             row_bpub = row_b - unpub_b * b32  # (M, R) composition of b_pub
 
@@ -372,8 +412,8 @@ def _make_kernel(
                 # i == j plane of the stored tensor) but every consumer
                 # below excludes it via ~onehot_b masks, so it needs no
                 # correction (tpusim.state.notify).
-                cpb = jnp.sum(cp * b32[:, None, None, :], axis=0)  # (M, M, R)
-                cpb_diag = jnp.sum(jnp.where(eye3, cpb, 0), axis=1)  # (M, R) cp[b, i, i]
+                cpb = jnp.sum(cp * b32[:, None, None, :], axis=0, dtype=cdt)  # (M, M, R)
+                cpb_diag = jnp.sum(jnp.where(eye3, cpb, 0), axis=1, dtype=cdt)  # (M, R) cp[b, i, i]
                 # Factored closed-form update (tpusim.state.notify — entry-
                 # for-entry equal to the historical 3-level case analysis):
                 #   Y[j] = (a_j | b_j) ? b_pub : cpb[j]
@@ -404,12 +444,15 @@ def _make_kernel(
                 npriv = jnp.where(adopt, 0, npriv)
                 bhp = jnp.where(do, best_h, bhp)
             else:
-                # Fast pairwise approximation (tpusim.state.notify).
+                # Fast pairwise approximation (tpusim.state.notify): the two
+                # nested selects collapse to one under the combined mask —
+                # both replacement values broadcast from (M, R) vectors
+                # selected by a_i alone (see the scan twin).
                 col_cp = oc_b - unpub_b * b32
                 ocp = jnp.where(
-                    adopt[:, None, :],
-                    row_bpub[:, None, :],
-                    jnp.where(adopt[None, :, :], col_cp[:, None, :], ocp),
+                    adopt[:, None, :] | adopt[None, :, :],
+                    jnp.where(adopt, row_bpub, col_cp)[:, None, :],
+                    ocp,
                 )
             oin = jnp.where(adopt[:, None, :], row_bpub[None, :, :], oin)
             ocnt = jnp.where(adopt, row_bpub, ocnt)
@@ -435,7 +478,9 @@ def _make_kernel(
 
             # Telemetry counters (engine.SimCounters semantics, bit-equal to
             # the scan engine's by construction: same masks, same operands).
-            dmax = jnp.max(d_stale, axis=0, keepdims=True)  # (1, R)
+            # Widened to int32 for the counter leaves, which stay wide
+            # regardless of the packed count dtype (engine._count_step).
+            dmax = jnp.max(d_stale, axis=0, keepdims=True).astype(I32)  # (1, R)
 
             if fcap:
                 # Flight recorder (tpusim.flight.record_step, runs-last): up
@@ -590,6 +635,16 @@ class PallasEngine(Engine):
             tile_runs = (
                 EXACT_TILE_RUNS if config.resolved_mode == "exact" else FAST_TILE_RUNS
             )
+            # Multi-run-per-kernel-instance grid for SMALL batches: a batch
+            # below the measured tile used to route wholly to the scan twin
+            # (run_batch's misalignment split). Shrinking the auto tile to
+            # the largest 128-multiple the batch fills keeps the runs on the
+            # kernel with every VPU lane busy — a batch of 256 runs as ONE
+            # 256-lane tile (grid cell) instead of zero kernel runs; the
+            # vmem_est guard below scales with the shrunk tile accordingly.
+            # Explicit tile_runs is never overridden.
+            if config.batch_size < tile_runs:
+                tile_runs = max(128, (config.batch_size // 128) * 128)
         if tile_runs % 128 != 0:
             raise ValueError("tile_runs must be a multiple of 128")
         if step_block < 1:
@@ -610,8 +665,16 @@ class PallasEngine(Engine):
         # have since shrunk, and only a hardware compile can say by how much.
         m, k = config.network.n_miners, config.resolved_group_slots
         exact = config.resolved_mode == "exact"
-        state_words = sum(math.prod(s) for s in _leaf_shapes(m, k, exact))
-        vmem_est = state_words * 4 * tile_runs * 10
+        from .state import COUNT_DTYPES
+
+        cdt = COUNT_DTYPES[config.resolved_count_dtype]
+        # dtype-aware state footprint: packed int16 count leaves halve their
+        # VMEM residency (the whole point of SimConfig.state_dtype).
+        state_bytes = sum(
+            math.prod(s) * jnp.dtype(d).itemsize
+            for s, d in zip(_leaf_shapes(m, k, exact), _leaf_dtypes(m, k, exact, cdt))
+        )
+        vmem_est = state_bytes * tile_runs * 10
         # The flight ring is VMEM-resident storage plus one (C, F, tile) row
         # select per recorded event — bulk, not contraction temporaries, so a
         # x2 allowance instead of the state's x10.
@@ -819,6 +882,22 @@ class PallasEngine(Engine):
             lambda kk: jax.random.bits(jax.random.fold_in(kk, 1 + chunk_idx), (steps, 2), U32),
             out_axes=2,
         )(keys)
+        if self.config.rng_batch:
+            # Batched wide generation (SimConfig.rng_batch): map the whole
+            # chunk's winner/interval words in ONE vectorized XLA pass and
+            # stream pre-mapped int32 (index, ms) rows into the kernel — the
+            # same elementwise maps as the scan engine's batched path
+            # (sampling.winners_from_bits / interval_from_bits), so the two
+            # engines stay bit-equal draw for draw.
+            from .sampling import interval_from_bits, winners_from_bits
+
+            bits = jnp.stack(
+                [
+                    winners_from_bits(bits[:, 0, :], params.thresholds),
+                    interval_from_bits(bits[:, 1, :], params.mean_interval_ms),
+                ],
+                axis=1,
+            )
 
         st = self._state_to_kernel(state)
         # Telemetry counters ride as extra runs-last kernel leaves after the
@@ -830,8 +909,11 @@ class PallasEngine(Engine):
                    ctr.active_steps[None, :],
                    jnp.moveaxis(ctr.stale_by_miner, 0, -1),
                    jnp.moveaxis(ctr.reorg_depth_hist, 0, -1))
+        cdt = self.count_dtype
         shapes = [s + (n,) for s in _leaf_shapes(m, k, self.exact)]
+        dtypes = list(_leaf_dtypes(m, k, self.exact, cdt))
         shapes += [(1, n)] * 3 + [(m, n), (DEPTH_BUCKETS, n)]
+        dtypes += [I32] * len(_TELE_LEAVES)
         fcap = self.flight_capacity
         if fcap:
             # Flight-recorder leaves (tpusim.flight): ring, count, and the
@@ -841,6 +923,7 @@ class PallasEngine(Engine):
             st = st + (jnp.moveaxis(fr.buf, 0, -1), fr.count[None, :],
                        jnp.stack([fr.base_hi, fr.base_lo]))
             shapes += [(fcap, N_FIELDS, n), (1, n), (2, n)]
+            dtypes += [I32] * len(_FLIGHT_LEAVES)
 
         def tile_spec(shape):
             block = shape[:-1] + (tile,)
@@ -861,7 +944,8 @@ class PallasEngine(Engine):
             exact=self.exact, any_selfish=self.any_selfish, sb=sb,
             mean_interval_ms=float(self.params.mean_interval_ms),
             n_state=len(shapes), superstep=self.superstep,
-            flight_capacity=fcap,
+            flight_capacity=fcap, rng_batch=self.config.rng_batch,
+            count_dtype=cdt,
         )
         grid = (n // tile, steps // sb)
         out = pl.pallas_call(
@@ -877,7 +961,7 @@ class PallasEngine(Engine):
                 *[tile_spec(s) for s in shapes],
             ],
             out_specs=[tile_spec(s) for s in shapes],
-            out_shape=[jax.ShapeDtypeStruct(s, I32) for s in shapes],
+            out_shape=[jax.ShapeDtypeStruct(s, d) for s, d in zip(shapes, dtypes)],
             input_output_aliases={6 + i: i for i in range(len(shapes))},
             interpret=self.interpret,
         )(bits, cap[None, :], self._lo, self._hi, self._prop, self._selfish, *st)

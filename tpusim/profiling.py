@@ -158,16 +158,28 @@ def time_chained_chunks(
 
 
 def state_bytes_per_run(engine) -> int:
-    """Bytes of simulation state per run: every int32 leaf of the engine's
-    mode/roster-resolved state tree (the Pallas kernel's leaf shapes are the
-    authority — they enumerate exactly the carried leaves in both modes)."""
+    """Bytes of simulation state per run: every leaf of the engine's
+    mode/roster-resolved state tree at its COMPILED dtype (the Pallas
+    kernel's leaf shape/dtype lists are the authority — they enumerate
+    exactly the carried leaves in both modes, and the packed-state int16
+    count leaves of SimConfig.state_dtype halve their share)."""
     import math as _math
 
-    from .pallas_engine import _leaf_shapes
+    import jax.numpy as _jnp
+
+    from .pallas_engine import _leaf_dtypes, _leaf_shapes
+    from .state import COUNT_DTYPES
 
     m = engine.n_miners
     k = engine.config.resolved_group_slots
-    return 4 * sum(_math.prod(s) for s in _leaf_shapes(m, k, engine.exact))
+    cdt = COUNT_DTYPES[engine.config.resolved_count_dtype]
+    return sum(
+        _math.prod(s) * _jnp.dtype(d).itemsize
+        for s, d in zip(
+            _leaf_shapes(m, k, engine.exact),
+            _leaf_dtypes(m, k, engine.exact, cdt),
+        )
+    )
 
 
 def bytes_per_event(engine) -> dict[str, float]:
@@ -177,11 +189,15 @@ def bytes_per_event(engine) -> dict[str, float]:
 
       * ``scan``  — the lax.scan carry makes one full read + write round
         trip of the state tree per event, plus the 8-byte (winner, interval)
-        word pair: ``2 * state + 8``. Supersteps do NOT change this model —
-        K events per scan step still update every leaf K times; what K
-        amortizes is per-step *control* overhead, which a bandwidth model
-        deliberately excludes (that gap is visible as distance from the
-        roof).
+        pair: ``2 * state + 8`` (8 bytes either way: two raw uint32 words on
+        the legacy path, two pre-mapped int32 draws under
+        SimConfig.rng_batch). Supersteps do NOT change this model — K events
+        per scan step still update every leaf K times; what K amortizes is
+        per-step *control* overhead, which a bandwidth model deliberately
+        excludes (that gap is visible as distance from the roof). State
+        packing (SimConfig.state_dtype) DOES change it: int16 count leaves
+        shrink ``state`` itself, i.e. they raise the roof rather than close
+        the distance to it.
       * ``pallas`` — state stays resident in VMEM across a whole chunk and
         crosses HBM once per chunk each way, so the per-event share is
         ``2 * state / chunk_steps``, plus the same 8 streamed RNG bytes.
@@ -237,6 +253,8 @@ def roofline_point(
     row = {
         **timing,
         "mode": engine.config.resolved_mode,
+        "state_dtype": engine.config.resolved_count_dtype,
+        "rng_batch": engine.config.rng_batch,
         "traffic_model": kind,
         "state_bytes_per_run": model["state_bytes_per_run"],
         "bytes_per_event": round(per_event, 2),

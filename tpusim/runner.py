@@ -316,6 +316,13 @@ def run_simulation_config(
     # statistic) is bit-identical across K — so it stays out of the
     # fingerprint (which also keeps pre-superstep checkpoints resumable).
     fp_dict.pop("superstep", None)
+    # Batched wide RNG and the packed-state dtype are pure compile-time
+    # knobs: the draws, their consumption order and every statistic are
+    # bit-identical either way (pinned by tests/test_rng_batch.py), so both
+    # stay out — checkpoints resume across rng_batch/state_dtype changes and
+    # across versions from before the knobs existed.
+    fp_dict.pop("rng_batch", None)
+    fp_dict.pop("state_dtype", None)
     # The default generator is omitted so checkpoints from before the rng
     # field existed (identical threefry draws) still resume; non-default
     # generators fingerprint explicitly.

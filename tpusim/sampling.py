@@ -38,6 +38,7 @@ __all__ = [
     "winner_thresholds32",
     "interval_from_bits",
     "winner_from_bits",
+    "winners_from_bits",
     "PERC_MULTIPLIER32",
 ]
 
@@ -93,4 +94,16 @@ def winner_from_bits(bits: jax.Array, thresholds: jax.Array) -> jax.Array:
     draws that fall past the 100% threshold; we clamp to the last miner.
     """
     w = jnp.sum((thresholds <= bits).astype(jnp.int32))
+    return jnp.minimum(w, jnp.int32(thresholds.shape[0] - 1))
+
+
+def winners_from_bits(bits: jax.Array, thresholds: jax.Array) -> jax.Array:
+    """Vectorized :func:`winner_from_bits` over any leading shape of draws:
+    one threshold-comparison pass maps a whole chunk's winner words at once
+    (the batched-RNG path, SimConfig.rng_batch). Elementwise identical to
+    the scalar form — same compare, same sum, same clamp — so the event loop
+    consuming these precomputed indices is bit-equal to per-event mapping."""
+    w = jnp.sum(
+        (thresholds <= bits[..., None]).astype(jnp.int32), axis=-1, dtype=jnp.int32
+    )
     return jnp.minimum(w, jnp.int32(thresholds.shape[0] - 1))

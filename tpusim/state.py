@@ -114,6 +114,13 @@ I32 = jnp.int32
 TIME = jnp.int32
 I64 = TIME  # back-compat alias used by tests/testing helpers
 
+#: Packed-state dtypes for the block-COUNT leaves (heights, private/stale
+#: counters, group counts, the consensus count tensors): int16 halves their
+#: scan-carry/VMEM footprint whenever SimConfig.resolved_count_dtype proves
+#: the per-run event bound fits (values are identical — int16 arithmetic is
+#: exact in range). Time leaves always stay TIME (int32): clocks span 2^30.
+COUNT_DTYPES = {"int32": jnp.int32, "int16": jnp.int16}
+
 #: Sentinel for "no arrival" (empty group slot). Strictly greater than any
 #: reachable in-chunk time. The reference uses milliseconds::max for private
 #: blocks (simulation.h:20); private blocks here are counted, not stored.
@@ -173,9 +180,14 @@ class SimState(NamedTuple):
 
     t: jax.Array  # int32 [] current chunk-relative simulation time (ms)
     next_block_time: jax.Array  # int32 [] relative time of the next block find
-    best_height_prev: jax.Array  # int32 [] best published height after last notify
+    # best_height_prev and n_private exist only for the selfish race/reveal
+    # machinery; a fast-mode honest roster carries None instead (an empty
+    # pytree leaf, like fast mode's cp) — the Pallas kernel's _FAST_LEAVES
+    # never had them, and the scan carry should not round-trip two leaves
+    # that are provably always zero.
+    best_height_prev: Optional[jax.Array]  # int32 [] best published height after last notify
     height: jax.Array  # int32 [M] own chain length (genesis excluded)
-    n_private: jax.Array  # int32 [M] trailing private selfish blocks
+    n_private: Optional[jax.Array]  # int32 [M] trailing private selfish blocks
     stale: jax.Array  # int32 [M] own blocks reorged out (simulation.h:133)
     base_tip_arrival: jax.Array  # int32 [M] arrival of highest arrived block
     group_arrival: jax.Array  # int32 [M, K] in-flight own block groups (sorted)
@@ -188,23 +200,39 @@ class SimState(NamedTuple):
     own_cnt: jax.Array  # int32 [M] own blocks in own chain = cp[i, i, i] (the authority)
 
 
-def init_state(n_miners: int, group_slots: int, exact: bool) -> SimState:
+def init_state(
+    n_miners: int, group_slots: int, exact: bool, count_dtype=I32,
+    any_selfish: bool = True,
+) -> SimState:
+    """``count_dtype`` (int32, or int16 when SimConfig.resolved_count_dtype
+    packs) types every block-count leaf; every update below derives its
+    arithmetic dtype from the leaves, so the carried tree keeps the packed
+    layout through the whole chunk (a dtype slip fails loud as a lax.scan
+    carry mismatch).
+
+    A fast-mode honest roster (``exact=False, any_selfish=False``) drops the
+    selfish-only leaves ``n_private``/``best_height_prev`` to None — both
+    are invariantly zero there, and None is an empty pytree leaf, so the
+    carry stops paying their HBM round trip (exact mode keeps them even for
+    honest rosters: its kernel leaf list is mode-, not roster-, shaped)."""
     m, k = n_miners, group_slots
+    cdt = count_dtype
+    keep_private = exact or any_selfish
     return SimState(
         t=jnp.zeros((), TIME),
         next_block_time=jnp.zeros((), TIME),
-        best_height_prev=jnp.zeros((), I32),
-        height=jnp.zeros((m,), I32),
-        n_private=jnp.zeros((m,), I32),
-        stale=jnp.zeros((m,), I32),
+        best_height_prev=jnp.zeros((), cdt) if keep_private else None,
+        height=jnp.zeros((m,), cdt),
+        n_private=jnp.zeros((m,), cdt) if keep_private else None,
+        stale=jnp.zeros((m,), cdt),
         base_tip_arrival=jnp.zeros((m,), TIME),
         group_arrival=jnp.full((m, k), INF_TIME, TIME),
-        group_count=jnp.zeros((m, k), I32),
+        group_count=jnp.zeros((m, k), cdt),
         overflow=jnp.zeros((), I32),
-        cp=jnp.zeros((m, m, m), I32) if exact else None,
-        own_cp=jnp.zeros((m, m), I32),
-        own_in=jnp.zeros((m, m), I32),
-        own_cnt=jnp.zeros((m,), I32),
+        cp=jnp.zeros((m, m, m), cdt) if exact else None,
+        own_cp=jnp.zeros((m, m), cdt),
+        own_in=jnp.zeros((m, m), cdt),
+        own_cnt=jnp.zeros((m,), cdt),
     )
 
 
@@ -234,8 +262,9 @@ def rebase(state: SimState) -> tuple[SimState, jax.Array]:
 
 
 def _at(vec: jax.Array, onehot: jax.Array) -> jax.Array:
-    """vec[w] for one-hot w, as arithmetic (no gather)."""
-    return jnp.sum(jnp.where(onehot, vec, 0), dtype=I32)
+    """vec[w] for one-hot w, as arithmetic (no gather); keeps vec's dtype so
+    packed count leaves stay packed."""
+    return jnp.sum(jnp.where(onehot, vec, 0), dtype=vec.dtype)
 
 
 def _push_groups(
@@ -253,8 +282,41 @@ def _push_groups(
     produces two blocks with one arrival). A full buffer merges into the last
     slot, keeping counts exact and arrival = the later one; this bounded-memory
     fallback is counted in the returned overflow increment.
+
+    K=2 (the auto slot count in both modes) takes a split-slot
+    specialization: the two slots as plain (M,) limbs with dense selects —
+    the Pallas kernel's push_groups2, ported to the scan layout after kernel
+    ablation attributed ~half the fast step to exactly this one-hot
+    machinery. Case-for-case equal to the generic path (same merge /
+    overflow-accumulate rules; slots fill left to right so ``c1 > 0``
+    implies full), pinned bit-equal by the state-equivalence and
+    scan-vs-pallas suites.
     """
     m, k = arr.shape
+    if k == 2:
+        a0, a1 = arr[:, 0], arr[:, 1]
+        c0, c1 = cnt[:, 0], cnt[:, 1]
+        e0 = c0 > 0
+        e1 = c1 > 0
+        last_arr = jnp.where(e1, a1, a0)
+        merge = do & e0 & (last_arr == new_arrival)
+        overflowed = do & ~merge & e1
+        w0 = do & (~e0 | (merge & ~e1))
+        w1 = do & e0 & (e1 | ~merge)
+        accum = merge | overflowed
+        ncnt = new_count.astype(cnt.dtype)
+        arr_new = jnp.stack(
+            [jnp.where(w0, new_arrival, a0), jnp.where(w1, new_arrival, a1)],
+            axis=-1,
+        )
+        cnt_new = jnp.stack(
+            [
+                jnp.where(w0, jnp.where(accum, c0 + ncnt, ncnt), c0),
+                jnp.where(w1, jnp.where(accum, c1 + ncnt, ncnt), c1),
+            ],
+            axis=-1,
+        )
+        return arr_new, cnt_new, jnp.sum(overflowed.astype(I32), dtype=I32)
     kidx = jnp.arange(k)[None, :]
     n = jnp.sum((cnt > 0).astype(I32), axis=-1, dtype=I32)  # [M]
     last_idx = jnp.maximum(n - 1, 0)
@@ -266,6 +328,7 @@ def _push_groups(
     onehot = (kidx == write_idx[:, None]) & do[:, None]
     arr_new = jnp.where(onehot, new_arrival[:, None], arr)
     accum = (merge | overflowed)[:, None]
+    new_count = new_count.astype(cnt.dtype)
     cnt_new = jnp.where(onehot, jnp.where(accum, cnt + new_count[:, None], new_count[:, None]), cnt)
     return arr_new, cnt_new, jnp.sum(overflowed.astype(I32), dtype=I32)
 
@@ -278,8 +341,30 @@ def _flush_groups(
     The arrived set is a prefix (groups are sorted), and the new base tip is
     the arrival of the last flushed group — the chain-highest arrived block,
     which is exactly the published-chain tip the first-seen rule compares
-    (main.cpp:74-76). Compaction is a K x K one-hot shift, not a gather."""
+    (main.cpp:74-76). Compaction is a K x K one-hot shift, not a gather.
+
+    K=2 takes the split-slot specialization (see :func:`_push_groups`):
+    sortedness makes the arrived set {f0, f0&f1}, so the flush-and-compact
+    is a handful of dense selects instead of the K x K one-hot shift."""
     m, k = arr.shape
+    if k == 2:
+        a0, a1 = arr[:, 0], arr[:, 1]
+        c0, c1 = cnt[:, 0], cnt[:, 1]
+        f0 = a0 <= t
+        f1 = a1 <= t
+        new_base = jnp.where(f1, a1, jnp.where(f0, a0, base_tip))
+        arr_new = jnp.stack(
+            [jnp.where(f1, INF_TIME, jnp.where(f0, a1, a0)),
+             jnp.where(f0, INF_TIME, a1)],
+            axis=-1,
+        )
+        zero = jnp.zeros((), cnt.dtype)
+        cnt_new = jnp.stack(
+            [jnp.where(f1, zero, jnp.where(f0, c1, c0)),
+             jnp.where(f0, zero, c1)],
+            axis=-1,
+        )
+        return arr_new, cnt_new, new_base
     kidx = jnp.arange(k)
     arrived = arr <= t
     n_f = jnp.sum(arrived.astype(I32), axis=-1, dtype=I32)
@@ -290,7 +375,7 @@ def _flush_groups(
     sel = kidx[None, None, :] == (kidx[None, :, None] + n_f[:, None, None])  # [M, K_dst, K_src]
     arr_new = jnp.sum(jnp.where(sel, arr[:, None, :], 0), axis=-1, dtype=I32)
     arr_new = jnp.where(jnp.any(sel, axis=-1), arr_new, INF_TIME)
-    cnt_new = jnp.sum(jnp.where(sel, cnt[:, None, :], 0), axis=-1, dtype=I32)
+    cnt_new = jnp.sum(jnp.where(sel, cnt[:, None, :], 0), axis=-1, dtype=cnt.dtype)
     return arr_new, cnt_new, new_base
 
 
@@ -319,6 +404,7 @@ def found_block(
     paper's case b); it is kept and unit-tested here the same way for parity.
     """
     m = state.height.shape[0]
+    cdt = state.height.dtype  # the count dtype (int32, or packed int16)
     onehot_w = jnp.arange(m) == w
     if any_selfish:
         is_selfish = jnp.any(onehot_w & params.selfish)
@@ -326,15 +412,15 @@ def found_block(
         height_w = _at(state.height, onehot_w)
         is_race = is_selfish & (n_private_w == 1) & (state.best_height_prev == height_w)
         private_append = is_selfish & ~is_race
-        push_count = jnp.where(is_race, I32(2), I32(1))
+        push_count = jnp.where(is_race, 2, 1).astype(cdt)
         push_do = onehot_w & ~private_append
         n_private = state.n_private + jnp.where(
             onehot_w,
-            jnp.where(private_append, I32(1), jnp.where(is_race, I32(-1), I32(0))),
-            I32(0),
-        )
+            jnp.where(private_append, 1, jnp.where(is_race, -1, 0)),
+            0,
+        ).astype(cdt)
     else:
-        push_count = I32(1)
+        push_count = jnp.ones((), cdt)
         push_do = onehot_w
         n_private = state.n_private
 
@@ -343,17 +429,17 @@ def found_block(
         state.group_arrival,
         state.group_count,
         arrival,
-        jnp.full((m,), push_count, I32),
+        jnp.full((m,), push_count, cdt),
         push_do,
     )
-    height = state.height + onehot_w.astype(I32)
+    height = state.height + onehot_w.astype(cdt)
 
     # The new block is above every lca and inside no common prefix: only the
     # own-count vector moves, in BOTH modes. The new block sits at
     # cp[w, w, w] / own_cp[w, w] / own_in[w, w] — all on the lazily-maintained
     # diagonals whose authority is own_cnt (module docstring) — so a find
     # touches no M^2 or M^3 state at all.
-    own_cnt = state.own_cnt + onehot_w.astype(I32)
+    own_cnt = state.own_cnt + onehot_w.astype(cdt)
 
     return state._replace(
         height=height,
@@ -374,14 +460,22 @@ def _best_chain(
     (owner one-hot, published height per miner, best height, best tip arrival).
     Ties on both height and tip arrival resolve to the lowest miner index,
     matching the reference's scan order with strict comparisons.
+    ``n_private`` is None for fast-mode honest rosters (invariantly zero).
     """
-    pub_height = height - n_private - jnp.sum(group_count, axis=-1, dtype=I32)
+    pub_height = height - jnp.sum(group_count, axis=-1, dtype=group_count.dtype)
+    if n_private is not None:
+        pub_height = pub_height - n_private
     best_h = jnp.max(pub_height)
     cand = pub_height == best_h
     tip_masked = jnp.where(cand, tip, INF_TIME)
     best_tip = jnp.min(tip_masked)
     winners = cand & (tip_masked == best_tip)
-    onehot_b = winners & (jnp.cumsum(winners.astype(I32)) == 1)  # first true
+    # First true along the miner axis as a min-index select (the kernel's
+    # construction — no sequential cumsum in the hot sweep); >= 1 candidate
+    # always exists, so the index is always < m.
+    m = pub_height.shape[0]
+    midx = jnp.arange(m)
+    onehot_b = midx == jnp.min(jnp.where(winners, midx, m))
     return onehot_b, pub_height, best_h, best_tip
 
 
@@ -418,7 +512,8 @@ def notify(
     onehot_b, pub_height, best_h, best_tip = _best_chain(
         state.height, state.n_private, cnt, base_tip
     )
-    b32 = onehot_b.astype(I32)
+    cdt = state.height.dtype  # the count dtype (int32, or packed int16)
+    b32 = onehot_b.astype(cdt)
 
     # --- Selfish reveal (simulation.h:149-174). Runs before reorg; only for
     # miners whose chain is at least as long as the best published one.
@@ -450,7 +545,7 @@ def notify(
     cnt_b = _at(own_cnt, onehot_b)  # own chain length in blocks of b
     # own_cp[:, b] = cp[i, b, i] with the stored (stale) [b, b] entry
     # corrected: own blocks in the common prefix with b.
-    oc_b = jnp.sum(own_cp * b32[None, :], axis=-1, dtype=I32)
+    oc_b = jnp.sum(own_cp * b32[None, :], axis=-1, dtype=cdt)
     oc_b = oc_b + b32 * (cnt_b - _at(oc_b, onehot_b))
     # Reorg stale accounting (simulation.h:129-135): own blocks above the
     # lca with b are popped on adoption.
@@ -459,7 +554,7 @@ def notify(
     # minus b's unpublished suffix: per-owner composition of the adopted
     # published chain. (Without the subtraction b's pending blocks would be
     # silently forgotten as future stale.)
-    row_b = jnp.sum(own_in * b32[:, None], axis=0, dtype=I32)
+    row_b = jnp.sum(own_in * b32[:, None], axis=0, dtype=cdt)
     row_b = row_b + b32 * (cnt_b - _at(row_b, onehot_b))
     row_bpub = row_b - unpub_b * b32  # [M] per-owner counts of b_pub
 
@@ -469,8 +564,8 @@ def notify(
         # onehot_b selects inside y_val/w_val (and yo/wo) overwrite the
         # b-row with row_bpub — derived from own_in, not cpb — wherever a
         # b-indexed value is used, so no correction is needed.
-        cpb = jnp.sum(cp * b32[:, None, None], axis=0, dtype=I32)  # [M, M]
-        cpb_diag = jnp.sum(cpb * jnp.eye(m, dtype=I32), axis=1, dtype=I32)  # [i] = cp[b, i, i]
+        cpb = jnp.sum(cp * b32[:, None, None], axis=0, dtype=cdt)  # [M, M]
+        cpb_diag = jnp.sum(cpb * jnp.eye(m, dtype=cdt), axis=1, dtype=cdt)  # [i] = cp[b, i, i]
 
         # Closed-form cp update: every adopter's chain becomes b's published
         # chain. Factored form — the historical 3-level case analysis
@@ -509,23 +604,31 @@ def notify(
         # — own blocks above any lca become 0, i.e. own_cp[i, :] =
         # own_cnt_new[i] = row_bpub[i]. Columns toward adopters: lca(i,
         # adopted chain) = lca(i, b_pub), whose own count is own_cp[i, b]
-        # minus b's unpublished suffix.
+        # minus b's unpublished suffix. Both replacement values are
+        # row-broadcasts of (M,) vectors selected by a_i alone, so the
+        # historical two nested (M, M) selects collapse to ONE select under
+        # the combined mask (case-for-case: a_i -> row_bpub[i]; ~a_i & a_j
+        # -> col_cp[i]) — one fewer pass over the densest fast-mode array.
         col_cp = oc_b - unpub_b * b32
         own_cp = jnp.where(
-            adopt[:, None],
-            row_bpub[:, None],
-            jnp.where(adopt[None, :], col_cp[:, None], own_cp),
+            adopt[:, None] | adopt[None, :],
+            jnp.where(adopt, row_bpub, col_cp)[:, None],
+            own_cp,
         )
 
     own_in = jnp.where(adopt[:, None], row_bpub[None, :], own_in)
     own_cnt = jnp.where(adopt, row_bpub, own_cnt)
 
     height = jnp.where(adopt, best_h, state.height)
-    n_private = jnp.where(adopt, 0, n_private)
+    if n_private is not None:
+        n_private = jnp.where(adopt, 0, n_private)
     arr = jnp.where(adopt[:, None], INF_TIME, arr)
     cnt = jnp.where(adopt[:, None], 0, cnt)
     base_tip = jnp.where(adopt, best_tip, base_tip)
-    bhp = best_h if do is None else jnp.where(do, best_h, state.best_height_prev)
+    if state.best_height_prev is None:
+        bhp = None
+    else:
+        bhp = best_h if do is None else jnp.where(do, best_h, state.best_height_prev)
 
     return state._replace(
         best_height_prev=bhp,
@@ -560,7 +663,9 @@ def final_stats(state: SimState, t_end: jax.Array) -> dict[str, jax.Array]:
     """
     m = state.height.shape[0]
     unarrived = jnp.sum(state.group_count * (state.group_arrival > t_end), axis=-1, dtype=I32)
-    pub_height = state.height - state.n_private - unarrived
+    pub_height = state.height - unarrived
+    if state.n_private is not None:
+        pub_height = pub_height - state.n_private
     arrived_mask = state.group_arrival <= t_end
     last_arrived = jnp.max(jnp.where(arrived_mask, state.group_arrival, NEG_TIME_CAP), axis=-1)
     tip = jnp.maximum(state.base_tip_arrival, last_arrived)
@@ -585,10 +690,13 @@ def final_stats(state: SimState, t_end: jax.Array) -> dict[str, jax.Array]:
         fpos, state.stale.astype(jnp.float32) / jnp.maximum(found, 1).astype(jnp.float32), 0.0
     )
     return {
-        "blocks_found": found,
+        # int32 outputs regardless of the packed count dtype: this is the
+        # boundary where packing ends — the engine's finalize sums these
+        # over the runs axis, which int16 could not survive.
+        "blocks_found": found.astype(I32),
         "blocks_share": share,
         "stale_rate": stale_rate,
-        "stale_blocks": state.stale,
-        "best_height": best_h,
+        "stale_blocks": state.stale.astype(I32),
+        "best_height": best_h.astype(I32),
         "overflow": state.overflow,
     }

@@ -39,10 +39,13 @@ __all__ = [
     "exporand",
     "engine_run_seeds",
     "select_streams",
+    "select_stream_by_count",
     "pack_run_streams",
     "unpack_run_streams",
     "interval_ms_from_word",
+    "next_words_wide",
     "winner_from_word64",
+    "winners_from_words64",
     "thresholds64_limbs",
 ]
 
@@ -132,6 +135,50 @@ def next_words(state: XoroStreams) -> tuple[XoroStreams, jax.Array, jax.Array]:
     n0l = r49l ^ x1l ^ sh21l
     n1h, n1l = _rotl64(x1h, x1l, 28)
     return XoroStreams(n0h, n0l, n1h, n1l), oh, ol
+
+
+def next_words_wide(
+    state: XoroStreams, k: int
+) -> tuple[list[XoroStreams], jax.Array, jax.Array]:
+    """Draw the next ``k`` outputs of every stream in one wide pass: returns
+    (the k successively-advanced states, out_hi (k, ...), out_lo (k, ...)).
+
+    Output word ``c`` is exactly the word ``c + 1`` sequential
+    :func:`next_words` calls would produce (pinned by
+    tests/test_rng_batch.py), so a consumer that takes word ``c`` for its
+    ``c``-th consumed draw and ends on ``states[c_total - 1]`` replays the
+    reference's conditional-advance stream order bit-for-bit — the
+    batched-RNG discipline of SimConfig.rng_batch: the sampler is
+    vectorized, the consumption order is not changed.
+    """
+    states: list[XoroStreams] = []
+    his, los = [], []
+    for _ in range(k):
+        state, h, l = next_words(state)
+        states.append(state)
+        his.append(h)
+        los.append(l)
+    return states, jnp.stack(his), jnp.stack(los)
+
+
+def select_stream_by_count(
+    count: jax.Array, state0: XoroStreams, states: list[XoroStreams]
+) -> XoroStreams:
+    """The stream state after ``count`` consumed draws, selected from a
+    :func:`next_words_wide` lookahead: ``count == 0`` keeps ``state0``,
+    ``count == c`` takes ``states[c - 1]`` — the wide path's equivalent of
+    per-event :func:`select_streams`."""
+    def pick(i: int):
+        stacked = jnp.stack(
+            [state0[i]] + [s[i] for s in states]
+        )  # (k + 1, ...)
+        onehot = jnp.arange(len(states) + 1) == count
+        shape = (-1,) + (1,) * (stacked.ndim - 1)
+        return jnp.sum(
+            jnp.where(onehot.reshape(shape), stacked, U32(0)), axis=0, dtype=U32
+        )
+
+    return XoroStreams(*(pick(i) for i in range(4)))
 
 
 def uniform_from_word(hi: jax.Array, lo: jax.Array) -> jax.Array:
@@ -233,6 +280,18 @@ def winner_from_word64(hi: jax.Array, lo: jax.Array, thr_hi: jax.Array,
     uint32 limb compares, bit-exact on TPU."""
     le = (thr_hi < hi) | ((thr_hi == hi) & (thr_lo <= lo))  # threshold <= draw
     w = jnp.sum(le, dtype=jnp.int32)
+    return jnp.minimum(w, jnp.int32(thr_hi.shape[0] - 1))
+
+
+def winners_from_words64(hi: jax.Array, lo: jax.Array, thr_hi: jax.Array,
+                         thr_lo: jax.Array) -> jax.Array:
+    """Vectorized :func:`winner_from_word64` over any leading shape of draws
+    (the wide lookahead of :func:`next_words_wide`): same limb compares, same
+    sum, same clamp per element, so consuming these precomputed winners is
+    bit-equal to mapping each word at its event."""
+    h, l = hi[..., None], lo[..., None]
+    le = (thr_hi < h) | ((thr_hi == h) & (thr_lo <= l))
+    w = jnp.sum(le, axis=-1, dtype=jnp.int32)
     return jnp.minimum(w, jnp.int32(thr_hi.shape[0] - 1))
 
 
