@@ -23,9 +23,11 @@
 // intervals drawn in nanoseconds, llround'ed, truncated to ms; winner draws
 // against cumulative uint64 thresholds pct * ((2^64-1)/100).
 
+#include <algorithm>
 #include <cassert>
 #include <cmath>
 #include <cstdint>
+#include <cstdio>
 #include <thread>
 #include <vector>
 
@@ -226,10 +228,37 @@ struct RunOut {
   double best_height = 0;
 };
 
+// One flight-recorder-schema event row (tpusim/flight.py row semantics):
+// kind indexes {find, arrival, stale, reorg}; the per-run sequence number is
+// the row's position in the trace vector.
+struct TraceEvent {
+  int64_t t_ms;
+  int32_t kind, miner, height, depth;
+};
+
+constexpr int32_t kKindFind = 0;
+constexpr int32_t kKindArrival = 1;
+constexpr int32_t kKindStale = 2;
+constexpr int32_t kKindReorg = 3;
+
 // One full Monte-Carlo run: event-driven loop with cut-through time advance.
+// `trace` (optional) records the run's event sequence in the JAX engines'
+// flight-recorder vocabulary — the cross-backend diff oracle. The
+// classification mirrors tpusim/flight.py record_step exactly:
+//   * one `find` row per drained same-ms find (miner = winner, height = its
+//     post-find chain length, private blocks included);
+//   * an `arrival` row only on iterations with NO find due (the
+//     find-folds-arrival rule): miner owns the earliest arrival newly
+//     visible in (last_sweep_t, t], lowest index on ties, height = its
+//     post-sweep chain length;
+//   * a `stale`/`reorg` row when the sweep made >= 1 miner adopt: depth is
+//     the max own-block pops by a single adopter, `stale` iff depth > 0,
+//     miner = the deepest-popping adopter (lowest index on ties), height =
+//     the adopted best length.
 RunOut simulate_run(const std::vector<MinerCfg>& cfg, int64_t duration_ms,
                     double interval_ns_mean, const std::vector<uint64_t>& thresholds,
-                    uint64_t seed, int64_t run_idx) {
+                    uint64_t seed, int64_t run_idx,
+                    std::vector<TraceEvent>* trace = nullptr) {
   uint64_t mix = seed;
   (void)splitmix64(mix);  // decorrelate from the Python key schedule trivially
   Xoro interval_rng(mix ^ (0x517cc1b727220a95ull * static_cast<uint64_t>(2 * run_idx + 1)));
@@ -253,14 +282,78 @@ RunOut simulate_run(const std::vector<MinerCfg>& cfg, int64_t duration_ms,
   int64_t t = 0;
   int64_t next_block = draw_interval();
   size_t best_len = 0;  // post-genesis length after the last notify sweep
+  // Trace bookkeeping: the previous sweep time bounds the "newly arrived"
+  // window (the JAX engine's groups hold only arrivals its last flush did
+  // not consume), and the pre-sweep snapshots identify adopters and their
+  // per-adoption pop counts.
+  int64_t last_sweep_t = -1;
+  std::vector<size_t> pre_h(miners.size());
+  std::vector<int64_t> pre_stale(miners.size());
   while (t < duration_ms) {
+    const bool find_due = (t == next_block);
     while (t == next_block) {
-      miners[draw_winner()].found_block(t, best_len);
+      const size_t w = draw_winner();
+      miners[w].found_block(t, best_len);
       next_block = t + draw_interval();
+      if (trace)
+        trace->push_back({t, kKindFind, static_cast<int32_t>(w),
+                          static_cast<int32_t>(miners[w].chain.size()), 0});
+    }
+    int32_t arrival_miner = -1;
+    if (trace) {
+      for (size_t i = 0; i < miners.size(); ++i) {
+        pre_h[i] = miners[i].chain.size();
+        pre_stale[i] = miners[i].stale;
+      }
+      if (!find_due) {
+        // Arrival attribution must read the PRE-sweep chains (the JAX
+        // recorder reads the step-entry groups): the sweep below may copy
+        // the newly-arrived block into adopters' chains — or pop the
+        // owner's own copy if the owner itself adopts — and a post-sweep
+        // scan would then misattribute the event. Earliest own-block
+        // arrival in (last_sweep_t, t], lowest miner on ties; the reverse
+        // scan stops at the first arrived-before-the-window block (the
+        // trailing region is the miner's own pushes with non-decreasing
+        // arrivals; adopted blocks all arrived at or before their adoption
+        // sweep).
+        int64_t amin = -1;
+        for (size_t i = 0; i < miners.size(); ++i) {
+          const auto& ch = miners[i].chain;
+          for (auto it = ch.rbegin(); it != ch.rend(); ++it) {
+            if (it->arrival == kPrivate) continue;
+            if (it->arrival <= last_sweep_t) break;
+            if (it->owner != miners[i].idx) continue;  // groups hold own blocks
+            if (it->arrival <= t && (amin < 0 || it->arrival < amin)) {
+              amin = it->arrival;
+              arrival_miner = static_cast<int32_t>(i);
+            }
+          }
+        }
+      }
     }
     const BestView best = best_published(miners, t);
     for (auto& m : miners) m.notify(best, t);
     best_len = best.len;
+    if (trace) {
+      if (arrival_miner >= 0)
+        trace->push_back(
+            {t, kKindArrival, arrival_miner,
+             static_cast<int32_t>(miners[arrival_miner].chain.size()), 0});
+      int32_t dmax = -1;
+      int32_t adopter = -1;
+      for (size_t i = 0; i < miners.size(); ++i) {
+        if (best.len <= pre_h[i]) continue;  // maybe_reorg's adopt gate
+        const auto d = static_cast<int32_t>(miners[i].stale - pre_stale[i]);
+        if (d > dmax) {  // strict >: ties keep the lowest miner index
+          dmax = d;
+          adopter = static_cast<int32_t>(i);
+        }
+      }
+      if (adopter >= 0)
+        trace->push_back({t, dmax > 0 ? kKindStale : kKindReorg, adopter,
+                          static_cast<int32_t>(best.len), dmax});
+      last_sweep_t = t;
+    }
     const int64_t arrival = earliest_pending(miners, t);
     t = arrival < 0 ? next_block : std::min(next_block, arrival);
   }
@@ -301,6 +394,69 @@ int simcore_rng_words(uint64_t seed, int64_t n, uint32_t* hi, uint32_t* lo) {
     hi[i] = static_cast<uint32_t>(w >> 32);
     lo[i] = static_cast<uint32_t>(w & 0xFFFFFFFFu);
   }
+  return 0;
+}
+
+// Runs `runs` simulations single-threaded and writes their event sequences
+// to `events_path` as the flight-recorder JSONL schema (tpusim/flight_export
+// decode_flight row dicts): one line per event, key order
+// {"run", "seq", "kind", "t_ms", "miner", "height", "depth"}, sorted by
+// (run, seq) — byte-compatible with `tpusim trace --events-out`, so the
+// README cross-backend diff recipe needs no hand-rolled harness. Tracing is
+// a debugging mode for runs small enough to read; thread fan-out would buy
+// nothing and cost ordering, so it is deliberately sequential. Returns 0 on
+// success, 1/2 on invalid arguments (as simcore_run), 3 when the output
+// file cannot be opened. `n_events_out` (optional) receives the total row
+// count.
+int simcore_run_events(int32_t n_miners, const int32_t* hashrate_pct,
+                       const int64_t* prop_ms, const uint8_t* selfish,
+                       int64_t duration_ms, double block_interval_s,
+                       int64_t runs, uint64_t seed, const char* events_path,
+                       int64_t* n_events_out) {
+  if (n_miners <= 0 || runs <= 0 || duration_ms <= 0 || block_interval_s <= 0) return 1;
+  std::vector<MinerCfg> cfg;
+  std::vector<uint64_t> thresholds;
+  uint64_t acc = 0;
+  int64_t pct_total = 0;
+  for (int32_t i = 0; i < n_miners; ++i) {
+    cfg.push_back({hashrate_pct[i], prop_ms[i], selfish[i] != 0});
+    pct_total += hashrate_pct[i];
+    acc += static_cast<uint64_t>(hashrate_pct[i]) * kPctMult;
+    thresholds.push_back(acc);
+  }
+  if (pct_total != 100) return 2;
+
+  std::FILE* f = std::fopen(events_path, "w");
+  if (!f) return 3;
+  static const char* const kKindNames[] = {"find", "arrival", "stale", "reorg"};
+  const double interval_ns_mean = block_interval_s * 1e9;
+  int64_t total = 0;
+  for (int64_t r = 0; r < runs; ++r) {
+    std::vector<TraceEvent> trace;
+    simulate_run(cfg, duration_ms, interval_ns_mean, thresholds, seed, r, &trace);
+    for (size_t e = 0; e < trace.size(); ++e) {
+      const TraceEvent& ev = trace[e];
+      std::fprintf(f,
+                   "{\"run\": %lld, \"seq\": %lld, \"kind\": \"%s\", "
+                   "\"t_ms\": %lld, \"miner\": %d, \"height\": %d, "
+                   "\"depth\": %d}\n",
+                   static_cast<long long>(r), static_cast<long long>(e),
+                   kKindNames[ev.kind], static_cast<long long>(ev.t_ms),
+                   ev.miner, ev.height, ev.depth);
+    }
+    total += static_cast<int64_t>(trace.size());
+  }
+  // A torn log (ENOSPC mid-fprintf, failed close flush) must not report
+  // success: `trace diff` would blame the truncation on a cross-backend
+  // divergence. Mirror the Python exporter's fail-clean rule
+  // (flight_export._write_artifact): remove the partial file, return the
+  // I/O error code.
+  const bool torn = std::ferror(f) != 0;
+  if (std::fclose(f) != 0 || torn) {
+    std::remove(events_path);
+    return 3;
+  }
+  if (n_events_out) *n_events_out = total;
   return 0;
 }
 

@@ -101,6 +101,16 @@ assert "batch" in names and "run" in names, names
 EOF
 env JAX_PLATFORMS=cpu python -m tpusim report "$tele_dir/smoke.jsonl" > /dev/null
 
+echo "== watch --once smoke =="
+# The live dashboard's snapshot mode on the fresh smoke ledger: must render
+# the convergence panel (the runner's per-batch `stats` spans) and exit 0 —
+# this is the dead-terminal / CI usage mode. Deliberately NO JAX_PLATFORMS:
+# `tpusim watch` is jax-free by design and must stay that way. The grep
+# targets a string only the POPULATED panel emits ("target rel hw") — a
+# bare "convergence" would also match the no-stats-spans fallback line and
+# let a dead stats pipeline slip through green.
+python -m tpusim watch --once "$tele_dir/smoke.jsonl" | grep -q "target rel hw"
+
 echo "== flight-recorder trace smoke =="
 # One tiny flight-enabled run end-to-end: export the Perfetto trace + JSONL
 # event log, validate the trace schema, and cross-check the event rows
@@ -120,5 +130,22 @@ assert n == len(events) > 0, (n, len(events))
 assert all(e["kind"] in KIND_NAMES for e in events)
 assert events == sorted(events, key=lambda e: (e["run"], e["seq"]))
 EOF
+
+echo "== cross-backend trace diff (JAX vs native) =="
+# The README "Event tracing" diff recipe end to end, no hand-rolled harness:
+# the scan engine under rng=xoroshiro (JAX_ENABLE_X64: the interval mapping
+# is bit-exact only in float64) and the native backend's trace producer
+# (simcore_run_events) must emit the SAME event sequence for the same seed;
+# `tpusim trace diff` localizes any divergence and exits nonzero on one.
+# 30 s propagation at a 6 h duration forces real races so the arrival/stale
+# classification paths are exercised, not just finds.
+env JAX_PLATFORMS=cpu JAX_ENABLE_X64=1 python -m tpusim trace --runs 2 \
+  --batch-size 2 --duration-ms 21600000 --single-device --quiet \
+  --rng xoroshiro --seed 11 --propagation-ms 30000 --flight-capacity 2048 \
+  --trace-out "$tele_dir/xoro.trace.json" --events-out "$tele_dir/jax_events.jsonl"
+python -m tpusim trace --backend cpp --runs 2 --duration-ms 21600000 \
+  --seed 11 --propagation-ms 30000 --quiet \
+  --events-out "$tele_dir/native_events.jsonl"
+python -m tpusim trace diff "$tele_dir/jax_events.jsonl" "$tele_dir/native_events.jsonl"
 
 echo "== CI green =="

@@ -1,0 +1,363 @@
+"""Streaming convergence telemetry: the moment-key pipeline (engine finalize
+-> host int64 fixed-point sums -> combine_sums), the runner's per-batch
+``stats`` spans, the CI/ETA derivation, and the `tpusim watch` / report
+convergence surfaces.
+
+The load-bearing invariant everything here leans on: the moment keys are
+EXACT integer sums of per-run quantized values, so their merge is
+associative and permutation/batching-invariant bit-for-bit — unlike the
+float64 ``*_sum`` folds, which need a tolerance.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from tpusim.config import SimConfig, default_network, reference_selfish_network
+from tpusim.convergence import (
+    STATS,
+    Z95,
+    MomentAccumulator,
+    derive_moments,
+    moment_keys,
+    quantize,
+)
+from tpusim.engine import Engine, combine_sums
+from tpusim.runner import make_run_keys, run_simulation_config
+from tpusim.telemetry import TelemetryRecorder, load_spans
+
+SMALL = SimConfig(
+    network=default_network(propagation_ms=1000),
+    duration_ms=86_400_000,
+    runs=8,
+    batch_size=4,
+    seed=3,
+)
+
+MOMENT_KEYS = sorted(
+    ["stats_n"]
+    + [f"stats_{s}_{w}" for s, _, _ in STATS for w in ("m1", "m2")]
+)
+
+
+# ---------------------------------------------------------------------------
+# The quantized-moment derivation itself.
+
+
+def test_derive_moments_matches_numpy():
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 50, size=(64, 3)).astype(np.int64)
+    q = quantize("blocks_found", x)
+    np.testing.assert_array_equal(q, x)  # scale 1: integers pass through
+    mean, se = derive_moments(64, q.sum(0), (q * q).sum(0), 1)
+    np.testing.assert_allclose(mean, x.mean(0), rtol=1e-12)
+    np.testing.assert_allclose(
+        se, x.std(0, ddof=1) / np.sqrt(64), rtol=1e-9
+    )
+    # n < 2: no variance estimate, se must be None (not a fake zero).
+    _, se1 = derive_moments(1, q[:1].sum(0), (q[:1] * q[:1]).sum(0), 1)
+    assert se1 is None
+
+
+def test_moment_merge_is_associative_and_permutation_invariant():
+    """combine_sums on moment keys is plain int64 addition, so any grouping
+    and any order of the same batches merges to the SAME bits — the property
+    that lets sweeps/resumes accumulate batches in whatever order dispatch
+    produces them."""
+    rng = np.random.default_rng(1)
+
+    def fake(n):
+        out = {"stats_n": np.int64(n)}
+        for s, _, _ in STATS:
+            out[f"stats_{s}_m1"] = rng.integers(0, 2**40, size=4)
+            out[f"stats_{s}_m2"] = rng.integers(0, 2**50, size=4)
+        return out
+
+    a, b, c = fake(4), fake(8), fake(2)
+    left = combine_sums(combine_sums(a, b), c)
+    right = combine_sums(a, combine_sums(b, c))
+    swapped = combine_sums(combine_sums(b, a), c)
+    for k in left:
+        np.testing.assert_array_equal(left[k], right[k], err_msg=k)
+        np.testing.assert_array_equal(left[k], swapped[k], err_msg=k)
+
+
+def test_accumulator_fold_and_snapshot_schema():
+    acc = MomentAccumulator()
+    x = np.array([[1.0], [2.0], [3.0], [4.0]], dtype=np.float32)
+    per = {
+        "blocks_found": x.astype(np.int32),
+        "blocks_share": x / 8.0,
+        "stale_rate": x / 16.0,
+    }
+    acc.add(moment_keys(per))
+    acc.add(moment_keys(per))
+    assert acc.n == 8
+    snap = acc.snapshot(target_rel_hw=0.01, rate_runs_per_s=100.0)
+    assert set(snap) == {s for s, _, _ in STATS}
+    entry = snap["blocks_found"]
+    # Two copies of [1..4]: mean 2.5, sd ~1.195 (ddof=1), hw = Z95 * sd/sqrt(8)
+    assert entry["mean"] == [2.5]
+    sd = np.std([1, 2, 3, 4] * 2, ddof=1)
+    np.testing.assert_allclose(entry["hw95"][0], Z95 * sd / np.sqrt(8), rtol=1e-4)
+    assert entry["rel_hw_max"] == pytest.approx(entry["hw95"][0] / 2.5, rel=1e-4)
+    assert entry["eta_runs"] > 0 and entry["eta_s"] > 0
+    # ETA scaling law: runs needed = n * (rel/target)^2.
+    assert entry["eta_runs"] == pytest.approx(
+        8 * (entry["rel_hw_max"] / 0.01) ** 2 - 8, rel=1e-3
+    )
+
+
+def test_stale_rate_clamp_bounds_the_quantized_range():
+    from tpusim.convergence import STALE_RATE_CLAMP
+
+    q = quantize("stale_rate", np.array([1e9, STALE_RATE_CLAMP, 0.25]))
+    assert q[0] == q[1]  # pathological ratio clamps instead of overflowing
+    assert q[2] == round(0.25 * (1 << 14))  # in-range values quantize exactly
+
+
+# ---------------------------------------------------------------------------
+# Engine wiring: keys present, split/dispatch invariant, scan == pallas.
+
+
+def test_run_batch_emits_moment_keys_and_batch_split_is_bit_invariant():
+    """One 512-run batch == two 256-run batches, BIT-equal on every moment
+    key (the satellite's headline pin) — and the m1 of blocks_found must
+    equal the device's own exact stat sum, tying the new telemetry to the
+    existing statistics."""
+    config = dataclasses.replace(
+        SMALL, duration_ms=43_200_000, runs=512, batch_size=512
+    )
+    eng = Engine(config)
+    whole = eng.run_batch(make_run_keys(config.seed, 0, 512))
+    assert sorted(k for k in whole if k.startswith("stats_")) == MOMENT_KEYS
+    assert int(whole["stats_n"]) == 512
+    a = eng.run_batch(make_run_keys(config.seed, 0, 256))
+    b = eng.run_batch(make_run_keys(config.seed, 256, 256))
+    merged = combine_sums(a, b)
+    for k in MOMENT_KEYS:
+        assert np.asarray(whole[k]).dtype == np.int64, k
+        np.testing.assert_array_equal(
+            np.asarray(whole[k]), np.asarray(merged[k]), err_msg=k
+        )
+    np.testing.assert_array_equal(
+        np.asarray(whole["stats_blocks_found_m1"]),
+        np.asarray(whole["blocks_found_sum"]).astype(np.int64),
+    )
+
+
+def test_moment_keys_equal_across_dispatch_paths():
+    eng = Engine(SMALL)
+    keys = make_run_keys(SMALL.seed, 0, 8)
+    device = eng.run_batch(keys)
+    host = eng.run_batch(keys, host_loop=True)
+    pipelined = eng.run_batch(keys, pipelined=True)
+    for k in MOMENT_KEYS:
+        np.testing.assert_array_equal(np.asarray(device[k]), np.asarray(host[k]), err_msg=k)
+        np.testing.assert_array_equal(np.asarray(device[k]), np.asarray(pipelined[k]), err_msg=k)
+
+
+def test_moment_keys_scan_vs_pallas_bit_equal():
+    """The moments derive from the engines' SHARED finalize over bit-equal
+    final state, so the kernel path must produce identical moment keys —
+    pinned on the racy selfish config where stale_rate is busy, including
+    the head/tail-split merge (batch 160 = one 128 tile + 32 scan runs)."""
+    from tpusim.pallas_engine import PallasEngine
+
+    config = SimConfig(
+        network=reference_selfish_network(),
+        duration_ms=86_400_000,
+        runs=160,
+        batch_size=160,
+        mode="exact",
+        chunk_steps=64,
+        seed=23,
+    )
+    keys = make_run_keys(config.seed, 0, config.runs)
+    scan = Engine(config).run_batch(keys)
+    pallas = PallasEngine(
+        config, tile_runs=128, step_block=32, interpret=True
+    ).run_batch(keys)
+    assert int(scan["stats_stale_rate_m2"].sum()) > 0  # the stat is live
+    for k in MOMENT_KEYS:
+        np.testing.assert_array_equal(
+            np.asarray(scan[k]), np.asarray(pallas[k]), err_msg=k
+        )
+
+
+def test_no_recompile_on_warmed_dispatch_with_stats():
+    from tpusim.testing import compile_count_guard
+
+    eng = Engine(SMALL)
+    keys = make_run_keys(SMALL.seed, 0, 8)
+    eng.run_batch(keys)
+    eng.run_batch(keys, pipelined=True)
+    with compile_count_guard(exact=0):
+        out = eng.run_batch(keys)
+        out_p = eng.run_batch(keys, pipelined=True)
+    assert "stats_n" in out and "stats_n" in out_p
+
+
+# ---------------------------------------------------------------------------
+# Runner span wiring + the dashboards.
+
+
+def _run_with_ledger(tmp_path, config, **kw):
+    led = tmp_path / "run.jsonl"
+    rec = TelemetryRecorder(led)
+    res = run_simulation_config(
+        config, use_all_devices=False, telemetry=rec, **kw
+    )
+    rec.close()
+    return led, load_spans(led), res
+
+
+def test_runner_emits_stats_spans(tmp_path):
+    led, spans, res = _run_with_ledger(tmp_path, SMALL)
+    sstats = [sp for sp in spans if sp["span"] == "stats"]
+    assert len(sstats) == 2  # one per batch
+    runs_seen = [sp["attrs"]["runs"] for sp in sstats]
+    assert runs_seen == [4, 8]
+    last = sstats[-1]["attrs"]
+    assert last["runs_total"] == SMALL.runs
+    assert last["duration_ms"] == SMALL.duration_ms
+    assert last["target_rel_hw"] == 0.01
+    assert last["rate_runs_per_s"] > 0
+    assert last["rate_is_first_batch"] is False  # batch 1 measured post-compile
+    assert sstats[0]["attrs"]["rate_is_first_batch"] is True
+    per = last["stats"]
+    assert set(per) == {s for s, _, _ in STATS}
+    m = SMALL.network.n_miners
+    for entry in per.values():
+        assert len(entry["mean"]) == m
+    # Cross-check against the run's own aggregated statistics: blocks_found
+    # is unquantized, so the streaming mean must equal the reported mean
+    # exactly; share agrees within the documented 2^-18 quantization.
+    found_mean = [ms.blocks_found_mean for ms in res.miners]
+    assert per["blocks_found"]["mean"] == pytest.approx(found_mean, abs=1e-9)
+    share_mean = [ms.blocks_share_mean for ms in res.miners]
+    assert per["blocks_share"]["mean"] == pytest.approx(share_mean, abs=2**-16)
+    # Same run_id correlation as every other span.
+    assert {sp["run_id"] for sp in sstats} == {spans[0]["run_id"]}
+
+
+def test_report_renders_convergence_panels(tmp_path):
+    from tpusim.report import render_report
+
+    led, spans, _ = _run_with_ledger(tmp_path, SMALL)
+    text = render_report(spans)
+    assert "Convergence (stats spans)" in text
+    assert "CI narrowing" in text
+    assert "blocks_share" in text
+    md = render_report(spans, fmt="md")
+    assert "## Convergence (stats spans)" in md
+
+
+def test_report_single_batch_ledger_is_flagged_not_raising(tmp_path):
+    """A single-batch ledger (runs == batch_size) has only the compile-
+    contaminated batch: the report must render a flagged estimate — in
+    prose, not just a table row — and the stats span must flag its rate
+    the same way (the steady_is_first_batch discipline)."""
+    from tpusim.report import render_report
+
+    cfg = dataclasses.replace(SMALL, runs=4, batch_size=4)
+    led, spans, _ = _run_with_ledger(tmp_path, cfg)
+    assert len([sp for sp in spans if sp["span"] == "batch"]) == 1
+    text = render_report(spans)
+    assert "single-batch ledger" in text
+    sstats = [sp for sp in spans if sp["span"] == "stats"]
+    assert sstats[-1]["attrs"]["rate_is_first_batch"] is True
+    assert "compile-contaminated" in render_report(spans)
+
+
+def test_single_run_ledger_renders_na_not_crash(tmp_path):
+    """n=1: no variance estimate exists; every surface must say n/a."""
+    from tpusim.report import render_report
+    from tpusim.watch import render_watch
+
+    cfg = dataclasses.replace(SMALL, runs=1, batch_size=1)
+    led, spans, _ = _run_with_ledger(tmp_path, cfg)
+    entry = [sp for sp in spans if sp["span"] == "stats"][-1]["attrs"]["stats"]
+    assert entry["blocks_found"]["se"] is None
+    assert entry["blocks_found"]["eta_runs"] is None
+    assert "n/a" in render_report(spans)
+    assert "n/a" in render_watch(spans, "x")
+
+
+def test_watch_once_and_live_exit(tmp_path, capsys):
+    from tpusim.watch import main as watch_main
+
+    led, spans, _ = _run_with_ledger(tmp_path, SMALL)
+    assert watch_main(["--once", str(led)]) == 0
+    out = capsys.readouterr().out
+    assert "convergence" in out
+    assert "COMPLETED" in out
+    assert "runs 8/8" in out
+    # Live mode exits by itself once the ledger's newest run has closed.
+    assert watch_main([str(led), "--interval", "0.01", "--no-clear"]) == 0
+    # Missing ledger in --once mode: explicit error, exit 2.
+    assert watch_main(["--once", str(tmp_path / "nope.jsonl")]) == 2
+
+
+def test_watch_renders_empty_and_foreign_ledgers(tmp_path):
+    from tpusim.watch import render_watch
+
+    assert "no parseable spans" in render_watch([], "x")
+    foreign = [{"run_id": "z", "span": "batch", "t_start": 0.0, "dur_s": 1.0,
+                "attrs": {"runs": 4}}]
+    text = render_watch(foreign, "x")
+    assert "no stats spans" in text
+    assert "SINGLE BATCH" in text  # flagged, mirroring steady_is_first_batch
+    # Partial/foreign stats entries (all-None hw95, non-dict values) render
+    # n/a on BOTH surfaces via the shared row builder instead of raising.
+    from tpusim.convergence import snapshot_rows
+    from tpusim.report import render_report
+
+    weird = [{"run_id": "z", "span": "stats", "t_start": 0.0, "dur_s": 0.0,
+              "attrs": {"runs": 2, "stats": {
+                  "blocks_found": {"hw95": [None, None]},
+                  "junk": "not-a-dict",
+              }}}]
+    assert snapshot_rows(weird[0]["attrs"]["stats"]) == [
+        ["blocks_found", "n/a", "n/a", "n/a"]
+    ]
+    assert "n/a" in render_watch(weird, "x")
+    assert "n/a" in render_report(weird)
+
+
+def test_cli_watch_dispatch(tmp_path, capsys):
+    from tpusim.cli import main as cli_main
+
+    led, _, _ = _run_with_ledger(tmp_path, SMALL)
+    assert cli_main(["watch", "--once", str(led)]) == 0
+    assert "tpusim watch" in capsys.readouterr().out
+
+
+def test_checkpoint_resume_restarts_accumulator(tmp_path):
+    """A checkpoint resume restarts the accumulator (moments are session
+    telemetry): the resumed session's stats spans count only its own runs,
+    while the checkpointed statistics still cover all of them."""
+    ck = tmp_path / "ck.npz"
+    cfg = dataclasses.replace(SMALL, runs=4, batch_size=4)
+    _run_with_ledger(tmp_path, cfg, checkpoint_path=ck)
+    led2 = tmp_path / "resume.jsonl"
+    rec = TelemetryRecorder(led2)
+    res = run_simulation_config(
+        dataclasses.replace(SMALL, runs=8, batch_size=4),
+        use_all_devices=False, telemetry=rec, checkpoint_path=ck,
+    )
+    rec.close()
+    spans = load_spans(led2)
+    sstats = [sp for sp in spans if sp["span"] == "stats"]
+    assert [sp["attrs"]["runs"] for sp in sstats] == [4]  # fresh accumulator
+    # ... but the run-level progress stays truthful: runs_done counts the
+    # resumed checkpoint's base, so watch's progress bar shows 8/8, not 4/8.
+    assert [sp["attrs"]["runs_done"] for sp in sstats] == [8]
+    from tpusim.watch import render_watch
+
+    assert "runs 8/8" in render_watch(spans, "x")
+    assert res.runs == 8  # statistics still resumed

@@ -1,0 +1,210 @@
+"""Cross-backend event tracing: the native backend's flight-recorder-schema
+JSONL producer (native/simcore.cpp simcore_run_events) and the structured
+`tpusim trace diff` localizer that replaces the README recipe's manual diff.
+
+The headline test drives the whole recipe: the scan engine under
+rng="xoroshiro" (in a JAX_ENABLE_X64 subprocess — the interval mapping is
+bit-exact only in float64) and the native producer must emit IDENTICAL event
+sequences for the same seed, on a roster that exercises every event kind
+including the prop-0 find-folds-arrival edge.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tpusim.config import MinerConfig, NetworkConfig, SimConfig
+from tpusim.flight_export import TraceDiff, diff_event_logs, load_events_jsonl
+
+pytestmark = pytest.mark.skipif(
+    not (Path(__file__).parent.parent / "native" / "simcore.cpp").exists(),
+    reason="native backend sources not present",
+)
+
+TINY = SimConfig(
+    network=NetworkConfig(
+        miners=(
+            MinerConfig(hashrate_pct=50, propagation_ms=5000),
+            MinerConfig(hashrate_pct=30, propagation_ms=2000),
+            MinerConfig(hashrate_pct=20, propagation_ms=0),
+        )
+    ),
+    duration_ms=86_400_000,
+    runs=4,
+    batch_size=4,
+    seed=42,
+    rng="xoroshiro",
+)
+
+
+def _row(run, seq, kind="find", t=10, miner=0, height=1, depth=0):
+    return {"run": run, "seq": seq, "kind": kind, "t_ms": t, "miner": miner,
+            "height": height, "depth": depth}
+
+
+# ---------------------------------------------------------------------------
+# The diff localizer itself (pure python).
+
+
+def test_diff_identical_logs():
+    a = [_row(0, 0), _row(0, 1, "arrival"), _row(1, 0)]
+    d = diff_event_logs(a, [dict(r) for r in a])
+    assert not d.divergent
+    assert d.n_a == d.n_b == 3
+    assert d.kinds_a == {"find": 2, "arrival": 1}
+    assert "identical" in d.render()
+
+
+def test_diff_reports_first_divergent_row_and_kind_deltas():
+    a = [_row(0, 0), _row(0, 1, "arrival", miner=1), _row(2, 5, "stale", depth=2)]
+    b = [_row(0, 0), _row(0, 1, "arrival", miner=2), _row(2, 5, "reorg")]
+    d = diff_event_logs(a, b)
+    assert d.divergent and d.first_key == (0, 1)
+    assert d.first_a["miner"] == 1 and d.first_b["miner"] == 2
+    text = d.render("A", "B")
+    assert "FIRST DIVERGENCE at (run 0, seq 1)" in text
+    assert "stale" in text and "reorg" in text  # per-kind count lines
+
+
+def test_diff_localizes_missing_rows_on_either_side():
+    a = [_row(0, 0), _row(0, 1, "arrival")]
+    d = diff_event_logs(a, a[:1])
+    assert d.first_key == (0, 1) and d.first_b is None
+    d2 = diff_event_logs(a[:1], a)
+    assert d2.first_key == (0, 1) and d2.first_a is None
+    # Order independence: the walk sorts by (run, seq) itself.
+    d3 = diff_event_logs(list(reversed(a)), [dict(r) for r in a])
+    assert not d3.divergent
+
+
+def test_load_events_jsonl_is_strict(tmp_path):
+    p = tmp_path / "ev.jsonl"
+    p.write_text(json.dumps(_row(0, 0)) + "\n{torn")
+    with pytest.raises(ValueError, match="unparseable"):
+        load_events_jsonl(p)
+    p.write_text('{"not": "an event"}\n')
+    with pytest.raises(ValueError, match="not an event row"):
+        load_events_jsonl(p)
+
+
+def test_trace_diff_cli_exit_codes(tmp_path, capsys):
+    from tpusim.flight_export import main as trace_main
+
+    a = tmp_path / "a.jsonl"
+    b = tmp_path / "b.jsonl"
+    a.write_text(json.dumps(_row(0, 0)) + "\n")
+    b.write_text(json.dumps(_row(0, 0)) + "\n")
+    assert trace_main(["diff", str(a), str(b)]) == 0
+    assert "identical" in capsys.readouterr().out
+    b.write_text(json.dumps(_row(0, 0, miner=3)) + "\n")
+    assert trace_main(["diff", str(a), str(b)]) == 1
+    assert "FIRST DIVERGENCE" in capsys.readouterr().out
+    assert trace_main(["diff", str(a), str(tmp_path / "missing.jsonl")]) == 2
+
+
+# ---------------------------------------------------------------------------
+# The native producer.
+
+
+def test_native_event_log_schema_and_order(tmp_path):
+    from tpusim.backend.cpp import run_events_cpp
+
+    out = tmp_path / "native.jsonl"
+    n = run_events_cpp(TINY, out)
+    events = load_events_jsonl(out)
+    assert n == len(events) > 0
+    # Exact key ORDER (not just key set): the README recipe's byte-level
+    # diffability against `tpusim trace --events-out` depends on it.
+    assert all(
+        list(e) == ["run", "seq", "kind", "t_ms", "miner", "height", "depth"]
+        for e in events
+    )
+    assert events == sorted(events, key=lambda e: (e["run"], e["seq"]))
+    kinds = {e["kind"] for e in events}
+    assert kinds <= {"find", "arrival", "stale", "reorg"}
+    assert "find" in kinds and "arrival" in kinds
+    # Per-run seqs are dense from 0.
+    for r in range(TINY.runs):
+        seqs = [e["seq"] for e in events if e["run"] == r]
+        assert seqs == list(range(len(seqs)))
+
+
+def test_native_rejects_bad_args(tmp_path):
+    from tpusim.backend.cpp import run_events_cpp
+
+    with pytest.raises(OSError):
+        run_events_cpp(TINY, tmp_path / "no_such_dir" / "x.jsonl")
+
+
+def test_native_matches_jax_flight_recorder(tmp_path):
+    """The tentpole contract of the satellite: the README cross-backend diff
+    recipe runs end to end with ZERO divergence — the JAX engine's flight
+    ring under rng=xoroshiro and the native producer describe the same
+    (seed, run) universe event for event."""
+    from tpusim.backend.cpp import run_events_cpp
+    from tpusim.probe import TUNNEL_TRIGGER_ENV
+
+    native = tmp_path / "native.jsonl"
+    run_events_cpp(TINY, native)
+
+    jax_log = tmp_path / "jax.jsonl"
+    env = os.environ.copy()
+    env.pop(TUNNEL_TRIGGER_ENV, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_ENABLE_X64"] = "1"
+    repo = str(Path(__file__).parent.parent)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tpusim", "trace",
+            "--runs", str(TINY.runs), "--batch-size", str(TINY.batch_size),
+            "--duration-ms", str(TINY.duration_ms), "--seed", str(TINY.seed),
+            "--rng", "xoroshiro", "--single-device", "--quiet",
+            "--hashrates", "50,30,20", "--propagation-ms", "5000,2000,0",
+            "--flight-capacity", "4096",
+            "--trace-out", str(tmp_path / "jax.trace.json"),
+            "--events-out", str(jax_log),
+        ],
+        capture_output=True, text=True, env=env, timeout=600, cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    d = diff_event_logs(load_events_jsonl(jax_log), load_events_jsonl(native))
+    assert isinstance(d, TraceDiff)
+    assert not d.divergent, d.render("jax", "native")
+    assert d.kinds_a.get("stale", 0) > 0  # the racy kinds are exercised
+    # And the logs are byte-identical, not merely row-equal: the C++ printf
+    # format matches json.dumps' separators.
+    assert jax_log.read_text() == native.read_text()
+
+
+def test_cpp_backend_trace_cli_surface(tmp_path, capsys):
+    from tpusim.flight_export import main as trace_main
+
+    out = tmp_path / "ev.jsonl"
+    rc = trace_main([
+        "--backend", "cpp", "--runs", "2", "--duration-ms", "43200000",
+        "--hashrates", "50,30,20", "--propagation-ms", "5000,2000,0",
+        "--seed", "1", "--events-out", str(out),
+    ])
+    assert rc == 0
+    assert "native backend wrote" in capsys.readouterr().out
+    assert len(load_events_jsonl(out)) > 0
+    # Flags that only mean something on the device ring are rejected loudly.
+    with pytest.raises(SystemExit, match="events-out"):
+        trace_main(["--backend", "cpp", "--runs", "2"])
+    with pytest.raises(SystemExit, match="flight-capacity"):
+        trace_main([
+            "--backend", "cpp", "--runs", "2", "--flight-capacity", "8",
+            "--events-out", str(out),
+        ])
+    with pytest.raises(SystemExit, match="trace-out"):
+        trace_main([
+            "--backend", "cpp", "--runs", "2",
+            "--trace-out", str(tmp_path / "t.json"), "--events-out", str(out),
+        ])

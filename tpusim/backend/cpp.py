@@ -71,8 +71,67 @@ def _load() -> ctypes.CDLL:
             ctypes.c_int32,  # threads
             dp, dp, dp, dp, dp,  # found, share, stale_rate, stale_blocks, best_height
         ]
+        # Guarded: a PREBUILT libsimcore.so from before the trace producer
+        # (the make-less fallback in _ensure_built) must keep serving
+        # run_simulation_cpp; only run_events_cpp needs the new symbol and
+        # it re-checks with a rebuild hint.
+        if hasattr(lib, "simcore_run_events"):
+            lib.simcore_run_events.restype = ctypes.c_int
+            lib.simcore_run_events.argtypes = [
+                ctypes.c_int32,  # n_miners
+                ctypes.POINTER(ctypes.c_int32),  # hashrate_pct
+                ctypes.POINTER(ctypes.c_int64),  # prop_ms
+                ctypes.POINTER(ctypes.c_uint8),  # selfish
+                ctypes.c_int64,  # duration_ms
+                ctypes.c_double,  # block_interval_s
+                ctypes.c_int64,  # runs
+                ctypes.c_uint64,  # seed
+                ctypes.c_char_p,  # events_path
+                ctypes.POINTER(ctypes.c_int64),  # n_events_out
+            ]
         _lib = lib
     return _lib
+
+
+def run_events_cpp(config: SimConfig, events_path) -> int:
+    """Run ``config`` on the native backend with event tracing and write the
+    flight-recorder-schema JSONL event log to ``events_path`` — the native
+    half of the README "Event tracing" cross-backend diff recipe (the JAX
+    half is ``tpusim trace --rng xoroshiro --events-out``; compare with
+    ``tpusim trace diff``). Single-threaded by design (traces are for runs
+    small enough to read). Returns the number of events written."""
+    lib = _load()
+    if not hasattr(lib, "simcore_run_events"):
+        raise NativeBuildError(
+            "libsimcore.so predates the event-trace producer; rebuild it "
+            "(make -C native libsimcore.so)"
+        )
+    n = config.network.n_miners
+    pct = np.array([m.hashrate_pct for m in config.network.miners], dtype=np.int32)
+    prop = np.array([m.propagation_ms for m in config.network.miners], dtype=np.int64)
+    selfish = np.array([m.selfish for m in config.network.miners], dtype=np.uint8)
+    n_events = ctypes.c_int64(0)
+    rc = lib.simcore_run_events(
+        n,
+        pct.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        prop.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        selfish.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        config.duration_ms,
+        config.network.block_interval_s,
+        config.runs,
+        config.seed,
+        str(events_path).encode(),
+        ctypes.byref(n_events),
+    )
+    if rc == 3:
+        # Open failure OR a torn write (the native side removes the partial
+        # file, mirroring flight_export._write_artifact's fail-clean rule).
+        raise OSError(
+            f"simcore_run_events could not open or fully write {events_path}"
+        )
+    if rc != 0:
+        raise ValueError(f"simcore_run_events rejected the configuration (code {rc})")
+    return int(n_events.value)
 
 
 def run_simulation_cpp(config: SimConfig, threads: int | None = None) -> SimResults:

@@ -11,12 +11,17 @@ Examples:
     python -m tpusim --config sweep.json --json out.json
     python -m tpusim --runs 1024 --telemetry artifacts/telemetry/run.jsonl
     python -m tpusim report artifacts/telemetry/run.jsonl --format md
+    python -m tpusim watch artifacts/telemetry/run.jsonl
     python -m tpusim trace --runs 4 --days 2 --trace-out flight.trace.json
+    python -m tpusim trace diff jax_events.jsonl native_events.jsonl
 
 The ``report`` subcommand (tpusim.report) renders a ``--telemetry`` JSONL
 ledger — or a ``--trace-dir`` XLA trace directory — into a dashboard; the
-``trace`` subcommand (tpusim.flight_export) runs with the device event
-flight recorder on and exports a Perfetto timeline / JSONL event log.
+``watch`` subcommand (tpusim.watch) is its live twin: a terminal dashboard
+that tails a growing ledger (``--once`` for a CI/dead-terminal snapshot);
+the ``trace`` subcommand (tpusim.flight_export) runs with the device event
+flight recorder on and exports a Perfetto timeline / JSONL event log, with
+``trace diff`` as the structured cross-backend event-log comparator.
 """
 
 from __future__ import annotations
@@ -107,7 +112,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--telemetry", type=Path, metavar="JSONL",
         help="append structured run spans (batches, checkpoints, retries, "
-        "device-side sim counters) here; render with `tpusim report`",
+        "device-side sim counters, per-batch convergence stats) here; "
+        "render with `tpusim report`, tail live with `tpusim watch`",
+    )
+    p.add_argument(
+        "--ci-target", type=float, default=0.01, metavar="REL_HW",
+        help="target relative 95%% CI half-width for the stats spans' "
+        "ETA extrapolation (default 0.01 = 1%%; needs --telemetry)",
     )
     p.add_argument(
         "--chaos", type=Path, metavar="PLAN",
@@ -172,6 +183,13 @@ def main(argv: list[str] | None = None) -> int:
         from .report import main as report_main
 
         return report_main(argv[1:])
+    if argv and argv[0] == "watch":
+        # Same dispatch rule as "report". Imports nothing heavy — the watch
+        # dashboard is jax-free by design, so it starts instantly on a
+        # machine that is busy running the simulation it observes.
+        from .watch import main as watch_main
+
+        return watch_main(argv[1:])
     if argv and argv[0] == "lint":
         # Same dispatch rule as "report". Imports nothing heavy: the linter
         # is pure-AST and must run (fast) in CI before any jax import.
@@ -274,6 +292,7 @@ def main(argv: list[str] | None = None) -> int:
                     tile_runs=args.tile_runs,
                     step_block=args.step_block,
                     chaos=chaos,
+                    ci_target_rel=args.ci_target,
                 )
         finally:
             if recorder is not None:

@@ -1,0 +1,249 @@
+"""Streaming convergence estimation: exact moment accumulators and the CI
+derivation behind the ``stats`` telemetry spans.
+
+The ROADMAP's adaptive-precision item ("run-until-confident") needs one
+substrate before any driver can exist: a running answer to *how converged is
+this simulation right now*. This module supplies it in three layers, all
+jax-free (the ``tpusim watch`` dashboard imports this without initializing a
+backend):
+
+  * **Moment keys** — :func:`moment_keys` turns one batch's per-run statistic
+    leaves (the device-computed ``blocks_found`` / ``blocks_share`` /
+    ``stale_rate`` per (run, miner) arrays the engines' shared finalize
+    already produces) into exact int64 first and second moments per miner
+    plus the run count. The float ratios are quantized to fixed point FIRST
+    (:data:`STATS`) so every downstream merge is integer addition — exact,
+    associative and permutation-invariant, which is what makes the moments
+    BIT-equal across batch splits, dispatch paths and the pallas head/tail
+    split (float summation is none of those things; the ±1e-6 slack in the
+    xoroshiro batching-invariance test exists because ``blocks_share_sum``
+    is a float64 fold). The keys ride ``engine.combine_sums``'s additive
+    rule.
+  * **Accumulator** — :class:`MomentAccumulator` folds batch moment dicts in
+    int64 across a whole run; ``runner.run_simulation_config`` emits its
+    :meth:`~MomentAccumulator.snapshot` as one ``stats`` telemetry span per
+    batch (same ``run_id`` correlation as every other span).
+  * **Derivation** — mean, standard error and the 95 % CI half-width per
+    (statistic, miner) from (n, m1, m2), plus the ETA extrapolation: CI
+    half-widths shrink as 1/sqrt(n), so the runs still needed to reach a
+    target relative half-width are ``n * ((rel_hw / target)^2 - 1)``.
+
+Quantization contract (per statistic): ``q = rint(clamp(x) * scale)`` as
+int64. ``blocks_found`` is integer already (scale 1); ``blocks_share`` lives
+in [0, 1] and quantizes at 2^-18 (~4e-6 — far under any CI width worth
+monitoring); ``stale_rate`` is clamped at :data:`STALE_RATE_CLAMP` = 16 (a
+stale rate of 16 is already pathology, and an unclamped ratio — stale can
+reach the event bound while found is 1 — would overflow the m2 budget) and
+quantizes at 2^-14. int64 overflow budgets at these scales: m2 grows at most
+2^36 per run for the ratio statistics, so sums stay exact past 2^27 ≈ 134 M
+runs per accumulator — far beyond any single run's plan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import numpy as np
+
+__all__ = [
+    "STATS",
+    "STALE_RATE_CLAMP",
+    "Z95",
+    "moment_keys",
+    "MomentAccumulator",
+    "derive_moments",
+    "format_num",
+    "format_eta",
+    "snapshot_rows",
+]
+
+#: Two-sided 95 % normal critical value (the CI the dashboards quote).
+Z95 = 1.959963984540054
+
+#: Stale-rate values are clamped here before quantization (see module
+#: docstring). Documented wherever the moments are surfaced.
+STALE_RATE_CLAMP = 16.0
+
+#: (statistic name, fixed-point scale, clamp or None) — the one authority for
+#: the quantization contract, shared by the engine's moment emission and
+#: every consumer's de-scaling.
+STATS: tuple[tuple[str, int, float | None], ...] = (
+    ("blocks_found", 1, None),
+    ("blocks_share", 1 << 18, None),
+    ("stale_rate", 1 << 14, STALE_RATE_CLAMP),
+)
+
+#: Key prefix of every moment output (``stats_n``, ``stats_<stat>_m1/m2``);
+#: the runner strips this prefix from the stat-sum path exactly like
+#: ``tele_``/``flight_`` keys.
+PREFIX = "stats_"
+
+
+def quantize(stat: str, values: np.ndarray) -> np.ndarray:
+    """Per-run fixed-point representation of one statistic's values (any
+    shape), as int64 — the only lossy step of the moment pipeline, applied
+    once per run value so every later reduction is exact."""
+    for name, scale, clamp in STATS:
+        if name == stat:
+            x = np.asarray(values, dtype=np.float64)
+            if clamp is not None:
+                x = np.minimum(x, clamp)
+            return np.rint(x * scale).astype(np.int64)
+    raise KeyError(f"unknown statistic {stat!r}")
+
+
+def moment_keys(per_run: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+    """One batch's moment keys from its per-run (runs, miners) statistic
+    arrays: ``stats_n`` plus int64 ``stats_<stat>_m1``/``_m2`` per miner.
+    All values merge additively (``engine.combine_sums``), and integer
+    addition makes that merge associative and permutation-invariant bit-for-
+    bit — the property the batch-split invariance test pins."""
+    out: dict[str, np.ndarray] = {}
+    n = None
+    for stat, _, _ in STATS:
+        q = quantize(stat, per_run[stat])
+        n = q.shape[0]
+        out[f"{PREFIX}{stat}_m1"] = q.sum(axis=0, dtype=np.int64)
+        out[f"{PREFIX}{stat}_m2"] = (q * q).sum(axis=0, dtype=np.int64)
+    out[f"{PREFIX}n"] = np.int64(n)
+    return out
+
+
+def derive_moments(
+    n: int, m1: np.ndarray, m2: np.ndarray, scale: int
+) -> tuple[np.ndarray, np.ndarray | None]:
+    """(mean, standard error) per miner from exact moment sums; the variance
+    is the usual unbiased ``(m2 - m1^2/n) / (n - 1)``, computed in float64
+    (m1^2 would overflow int64 long before the sums themselves do). A
+    single-run accumulator has no variance estimate: se is None, and the
+    dashboards must render "n/a" instead of a fake zero-width CI."""
+    m1f = np.asarray(m1, dtype=np.float64)
+    mean = m1f / (n * scale)
+    if n < 2:
+        return mean, None
+    var_q = (np.asarray(m2, dtype=np.float64) - m1f * m1f / n) / (n - 1)
+    se = np.sqrt(np.maximum(var_q, 0.0) / n) / scale
+    return mean, se
+
+
+def format_num(x: Any, digits: int = 4) -> str:
+    """Human rendering of one snapshot number; None (underivable — n < 2, or
+    an all-zero-mean statistic) renders as "n/a", never a fabricated 0.
+    Shared by `tpusim watch` and the report convergence panels so the two
+    surfaces cannot drift apart."""
+    if x is None:
+        return "n/a"
+    return f"{float(x):.{digits}g}"
+
+
+def format_eta(eta_runs: Any, eta_s: Any) -> str:
+    """Human rendering of one snapshot's ETA pair (runs + seconds at the
+    measured rate) — the one implementation behind both dashboards."""
+    if eta_runs is None:
+        return "n/a"
+    if eta_runs == 0:
+        return "target met"
+    txt = f"~{float(eta_runs):.3g} runs"
+    if eta_s is not None:
+        s = float(eta_s)
+        txt += f" ({s:.1f} s)" if s < 120 else f" ({s / 60:.1f} min)"
+    return txt
+
+
+def snapshot_rows(per_stat: dict[str, Any]) -> list[list[str]]:
+    """The convergence table rows ([stat, worst rel hw, max hw95, eta]) from
+    one ``stats`` span's ``stats`` attr — THE shared row builder behind the
+    `tpusim watch` panel and the report convergence panel, so the two
+    dashboards render one ledger structurally identically. Tolerates foreign
+    or partial entries (missing keys, all-None hw95 lists) with "n/a"
+    instead of raising: both surfaces promise crash-tolerant rendering of
+    arbitrary ledgers."""
+    rows = []
+    for stat, entry in (per_stat or {}).items():
+        if not isinstance(entry, dict):
+            continue
+        hw = entry.get("hw95")
+        hw_max = (
+            max(v for v in hw if v is not None)
+            if isinstance(hw, list) and any(v is not None for v in hw) else None
+        )
+        rows.append([
+            str(stat),
+            format_num(entry.get("rel_hw_max")),
+            format_num(hw_max),
+            format_eta(entry.get("eta_runs"), entry.get("eta_s")),
+        ])
+    return rows
+
+
+def _sig(x: float | None) -> float | None:
+    """6-significant-digit rounding for span compactness."""
+    if x is None:
+        return None
+    return float(f"{float(x):.6g}")
+
+
+@dataclasses.dataclass
+class MomentAccumulator:
+    """Run-scoped fold of per-batch moment keys (exact int64 throughout).
+
+    Session-scoped like the ``tele_`` counters: a checkpoint-resumed run
+    starts a fresh accumulator (moments are telemetry, not statistics — the
+    checkpointed stat sums are unaffected)."""
+
+    n: int = 0
+    m1: dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
+    m2: dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
+
+    def add(self, stats: dict[str, Any]) -> None:
+        """Fold one batch's ``stats_*`` keys (a ``run_batch`` output's moment
+        group, already host numpy)."""
+        self.n += int(stats[f"{PREFIX}n"])
+        for stat, _, _ in STATS:
+            for which, store in (("m1", self.m1), ("m2", self.m2)):
+                v = np.asarray(stats[f"{PREFIX}{stat}_{which}"], dtype=np.int64)
+                store[stat] = v if stat not in store else store[stat] + v
+
+    def snapshot(
+        self,
+        *,
+        target_rel_hw: float | None = None,
+        rate_runs_per_s: float | None = None,
+    ) -> dict[str, dict[str, Any]]:
+        """JSON-ready per-statistic convergence state for one ``stats`` span:
+        per-miner mean/se/95 %-half-width lists, the worst relative
+        half-width across miners (the number that must cross the target),
+        and the ETA extrapolation toward ``target_rel_hw`` at
+        ``rate_runs_per_s``. Fields that cannot be derived yet (n < 2, or a
+        statistic whose means are all zero) are None, never fabricated."""
+        out: dict[str, dict[str, Any]] = {}
+        for stat, scale, _ in STATS:
+            if stat not in self.m1:
+                continue
+            mean, se = derive_moments(self.n, self.m1[stat], self.m2[stat], scale)
+            entry: dict[str, Any] = {"mean": [_sig(v) for v in mean]}
+            if se is None:
+                entry.update(se=None, hw95=None, rel_hw_max=None,
+                             eta_runs=None, eta_s=None)
+                out[stat] = entry
+                continue
+            hw = Z95 * se
+            entry["se"] = [_sig(v) for v in se]
+            entry["hw95"] = [_sig(v) for v in hw]
+            nz = np.abs(mean) > 0
+            rel = float(np.max(hw[nz] / np.abs(mean[nz]))) if nz.any() else None
+            entry["rel_hw_max"] = _sig(rel)
+            eta_runs = eta_s = None
+            if rel is not None and target_rel_hw and target_rel_hw > 0:
+                # Half-widths shrink as 1/sqrt(n): runs needed for the target
+                # is n * (rel/target)^2, so the remaining distance is the
+                # difference (0 once the target is met).
+                eta_runs = max(0, math.ceil(self.n * (rel / target_rel_hw) ** 2) - self.n)
+                if rate_runs_per_s and rate_runs_per_s > 0:
+                    eta_s = _sig(eta_runs / rate_runs_per_s)
+            entry["eta_runs"] = eta_runs
+            entry["eta_s"] = eta_s
+            out[stat] = entry
+        return out

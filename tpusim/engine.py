@@ -54,6 +54,7 @@ from .compat import shard_map
 
 from .chaos import InjectedHang, PipelineStallError, fetch_with_deadline
 from .config import SimConfig
+from .convergence import STATS as MOMENT_STATS, moment_keys
 from .sampling import interval_from_bits, winner_from_bits
 from .state import (
     TIME_CAP,
@@ -219,7 +220,14 @@ def combine_sums(a: dict[str, np.ndarray], b: dict[str, np.ndarray]) -> dict[str
     stat sums, elementwise max for the ``*_max`` telemetry keys (a batch's
     busy-chunk count / deepest reorg is the max over its runs, and run
     behavior is batching-invariant under the counter-based RNG), and
-    run-axis concatenation for the per-run flight-recorder arrays."""
+    run-axis concatenation for the per-run flight-recorder arrays.
+
+    The streaming-moment keys (``stats_n``, ``stats_<stat>_m1/m2`` —
+    tpusim.convergence) ride the additive branch deliberately: they are
+    int64 fixed-point sums, so this merge is exact, hence associative and
+    permutation-invariant bit-for-bit — the property that keeps the
+    convergence estimator identical across batch splits and the pallas
+    head/tail split (pinned by tests/test_convergence.py)."""
     def merge(k):
         if k.startswith("flight_"):
             return np.concatenate([np.asarray(a[k]), np.asarray(b[k])])
@@ -253,12 +261,40 @@ def _host_reduce_telemetry(out: dict[str, np.ndarray], busy_chunks: int) -> None
 def _host_reduce_sums(out: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
     """Collapse the per-run float32 ratio leaves into float64 host sums —
     the finalize boundary where ~1e-5 float32 accumulation noise on 8k-run
-    batches is eliminated (see finalize_fn). A dict without per-run leaves
-    (the multi-controller device-psum path) passes through unchanged."""
-    for name in ("blocks_share", "stale_rate"):
+    batches is eliminated (see finalize_fn) — and spill the streaming-moment
+    telemetry keys (tpusim.convergence.moment_keys: exact int64 first/second
+    moments per miner of blocks_found / blocks_share / stale_rate, plus the
+    run count) from the same per-run leaves. Like the counter reduction in
+    ``_host_reduce_telemetry``, the moment sums happen at this host boundary
+    in 64-bit — an on-device 32-bit sum of squared counts would overflow
+    within one large batch. A dict without per-run leaves (the
+    multi-controller device-psum path) passes through unchanged and emits no
+    moment keys."""
+    per: dict[str, np.ndarray] = {}
+    for name, _, _ in MOMENT_STATS:
         per_run = out.pop(name + "_per_run", None)
         if per_run is not None:
-            out[name + "_sum"] = per_run.astype(np.float64).sum(axis=0)
+            per[name] = per_run
+    for name in ("blocks_share", "stale_rate"):
+        # The float32 ratio leaves also feed the statistics path (their
+        # float64 host sums); blocks_found's stat sum is the exact device
+        # int sum and needs no host fold.
+        if name in per:
+            out[name + "_sum"] = per[name].astype(np.float64).sum(axis=0)
+    if per:
+        if len(per) != len(MOMENT_STATS):
+            # Partial presence is a wiring bug, not a legal path: the psum
+            # path produces NO per-run leaves, the finalize path produces
+            # all of them. Fail loud so extending convergence.STATS cannot
+            # silently stop (or half-emit) the moment telemetry.
+            raise RuntimeError(
+                f"streaming-moment wiring incomplete: finalize produced "
+                f"per-run leaves {sorted(per)} but convergence.STATS "
+                f"declares {[n for n, _, _ in MOMENT_STATS]}; add the "
+                f"missing <stat>_per_run leaf to finalize_fn and the mesh "
+                f"out_specs"
+            )
+        out.update(moment_keys(per))
     return out
 
 
@@ -629,6 +665,11 @@ class Engine:
                 # transfer per batch (~0.3 MB at the default batch size).
                 "blocks_share_per_run": per_run["blocks_share"],
                 "stale_rate_per_run": per_run["stale_rate"],
+                # Per-run found counts feed ONLY the streaming-moment
+                # telemetry (second moments need per-run values; the stat
+                # path keeps the exact device int sum above). Same transfer
+                # budget class as the two ratio leaves.
+                "blocks_found_per_run": per_run["blocks_found"],
             }
 
         vinit = jax.vmap(init_fn, in_axes=(0, None))
@@ -683,20 +724,27 @@ class Engine:
                 out_specs.update(blocks_share_sum=P(), stale_rate_sum=P())
             else:
                 out_specs.update(
-                    blocks_share_per_run=P("runs"), stale_rate_per_run=P("runs")
+                    blocks_share_per_run=P("runs"), stale_rate_per_run=P("runs"),
+                    blocks_found_per_run=P("runs"),
                 )
 
             def sharded_finalize(state, t_end):
                 local = finalize_fn(state, t_end)
                 share = local.pop("blocks_share_per_run")
                 stale = local.pop("stale_rate_per_run")
+                found = local.pop("blocks_found_per_run")
                 out = jax.tree_util.tree_map(lambda x: jax.lax.psum(x, "runs"), local)
                 if multiproc:
+                    # Non-addressable shards cannot reach the host moment
+                    # reduction, so multi-controller runs emit no streaming-
+                    # moment keys (same policy as the flight ring): the found
+                    # per-run leaf is dropped with them.
                     out["blocks_share_sum"] = jax.lax.psum(jnp.sum(share, axis=0), "runs")
                     out["stale_rate_sum"] = jax.lax.psum(jnp.sum(stale, axis=0), "runs")
                 else:
                     out["blocks_share_per_run"] = share
                     out["stale_rate_per_run"] = stale
+                    out["blocks_found_per_run"] = found
                 return out
 
             self._finalize = jax.jit(
@@ -720,6 +768,7 @@ class Engine:
                     "best_height_sum": P(), "overflow_sum": P(),
                     "blocks_share_per_run": P("runs"),
                     "stale_rate_per_run": P("runs"),
+                    "blocks_found_per_run": P("runs"),
                     "tele_reorg_depth_per_run": P("runs"),
                     "tele_stale_events_per_run": P("runs"),
                     "tele_active_steps_per_run": P("runs"),

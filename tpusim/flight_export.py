@@ -25,6 +25,17 @@ CLI::
     python -m tpusim trace --runs 4 --days 2 --flight-capacity 1024 \
         --trace-out artifacts/telemetry/sample.trace.json \
         --events-out /tmp/events.jsonl
+
+Cross-backend workflow: ``--backend cpp`` emits the SAME event-log schema
+from the native backend (native/simcore.cpp writes it directly — the oracle
+side of the README diff recipe), and ``tpusim trace diff A.jsonl B.jsonl``
+is the structured comparator: first divergent (run, seq) row with both
+sides printed, per-kind event-count deltas, nonzero exit on divergence —
+the recipe's manual ``diff`` replaced by a localizer::
+
+    python -m tpusim trace --rng xoroshiro --seed 7 ... --events-out a.jsonl
+    python -m tpusim trace --backend cpp --seed 7 ... --events-out b.jsonl
+    python -m tpusim trace diff a.jsonl b.jsonl
 """
 
 from __future__ import annotations
@@ -41,7 +52,8 @@ from .flight import FLIGHT_TIME_BASE, KIND_NAMES, N_FIELDS
 
 __all__ = [
     "FlightLog", "decode_flight", "events_jsonl", "perfetto_trace",
-    "validate_perfetto", "main",
+    "validate_perfetto", "TraceDiff", "load_events_jsonl", "diff_event_logs",
+    "main",
 ]
 
 
@@ -169,6 +181,122 @@ def validate_perfetto(trace: Any) -> int:
     return n
 
 
+@dataclasses.dataclass
+class TraceDiff:
+    """Structured comparison of two event logs (see :func:`diff_event_logs`)."""
+
+    #: (run, seq) key of the first divergent row, or None when identical.
+    first_key: tuple[int, int] | None
+    #: The divergent rows themselves (None on the side missing the key).
+    first_a: dict | None
+    first_b: dict | None
+    #: Per-kind event counts of each log.
+    kinds_a: dict[str, int]
+    kinds_b: dict[str, int]
+    n_a: int
+    n_b: int
+
+    @property
+    def divergent(self) -> bool:
+        return self.first_key is not None
+
+    def render(self, name_a: str = "A", name_b: str = "B") -> str:
+        out = [f"trace diff: {name_a} ({self.n_a} events) vs {name_b} "
+               f"({self.n_b} events)"]
+        kinds = sorted(set(self.kinds_a) | set(self.kinds_b))
+        for kind in kinds:
+            na, nb = self.kinds_a.get(kind, 0), self.kinds_b.get(kind, 0)
+            delta = f"{nb - na:+d}" if na != nb else "=="
+            out.append(f"  {kind:8s} {na:8d} {nb:8d}  {delta}")
+        if not self.divergent:
+            out.append("identical event sequences")
+        else:
+            run, seq = self.first_key
+            out.append(f"FIRST DIVERGENCE at (run {run}, seq {seq}):")
+            out.append(f"  {name_a}: " + (json.dumps(self.first_a) if self.first_a
+                                          else "<no row>"))
+            out.append(f"  {name_b}: " + (json.dumps(self.first_b) if self.first_b
+                                          else "<no row>"))
+        return "\n".join(out) + "\n"
+
+
+def load_events_jsonl(path: Path) -> list[dict]:
+    """Parse an event log STRICTLY (unlike telemetry.load_spans): these files
+    are freshly produced oracle inputs, and a torn or foreign line in one is
+    itself a divergence that must fail loud, not be skipped."""
+    events = []
+    for i, line in enumerate(Path(path).read_text().splitlines()):
+        if not line.strip():
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"{path}:{i + 1}: unparseable event line ({e})") from None
+        if not isinstance(row, dict) or "run" not in row or "seq" not in row:
+            raise ValueError(f"{path}:{i + 1}: not an event row: {line[:120]!r}")
+        events.append(row)
+    return events
+
+
+def diff_event_logs(a: list[dict], b: list[dict]) -> TraceDiff:
+    """Compare two event logs row-by-row in (run, seq) order: the first key
+    where the rows differ — or exist on one side only — is the divergence
+    point (everything after the first divergent event of a run is causally
+    suspect, so ONE localized row beats a full dump)."""
+    key = lambda e: (int(e["run"]), int(e["seq"]))
+    a = sorted(a, key=key)
+    b = sorted(b, key=key)
+    kinds_a: dict[str, int] = {}
+    kinds_b: dict[str, int] = {}
+    for e in a:
+        kinds_a[str(e.get("kind"))] = kinds_a.get(str(e.get("kind")), 0) + 1
+    for e in b:
+        kinds_b[str(e.get("kind"))] = kinds_b.get(str(e.get("kind")), 0) + 1
+    first_key = first_a = first_b = None
+    ia = ib = 0
+    while ia < len(a) or ib < len(b):
+        ka = key(a[ia]) if ia < len(a) else None
+        kb = key(b[ib]) if ib < len(b) else None
+        if ka is not None and (kb is None or ka < kb):
+            first_key, first_a, first_b = ka, a[ia], None
+            break
+        if kb is not None and (ka is None or kb < ka):
+            first_key, first_a, first_b = kb, None, b[ib]
+            break
+        if a[ia] != b[ib]:
+            first_key, first_a, first_b = ka, a[ia], b[ib]
+            break
+        ia += 1
+        ib += 1
+    return TraceDiff(
+        first_key=first_key, first_a=first_a, first_b=first_b,
+        kinds_a=kinds_a, kinds_b=kinds_b, n_a=len(a), n_b=len(b),
+    )
+
+
+def diff_main(argv: list[str] | None = None) -> int:
+    """``tpusim trace diff``: exit 0 on identical logs, 1 on divergence
+    (with the first divergent row localized), 2 on unreadable input."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="tpusim trace diff",
+        description="Structured diff of two flight-recorder JSONL event logs.",
+    )
+    ap.add_argument("a", type=Path, help="first event log (e.g. the JAX engine's)")
+    ap.add_argument("b", type=Path, help="second event log (e.g. the native backend's)")
+    args = ap.parse_args(argv)
+    try:
+        ev_a = load_events_jsonl(args.a)
+        ev_b = load_events_jsonl(args.b)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    diff = diff_event_logs(ev_a, ev_b)
+    print(diff.render(str(args.a), str(args.b)), end="")
+    return 1 if diff.divergent else 0
+
+
 def _write_artifact(path: Path, text: str) -> None:
     """Write one export artifact, failing CLEAN on a torn write: a half-
     written trace JSON (ENOSPC, yanked volume) parses as nothing yet still
@@ -195,6 +323,11 @@ def main(argv: list[str] | None = None) -> int:
     small enough to read, and per-run identity must stay trivially stable."""
     from .cli import build_parser, config_from_args
 
+    if argv and argv[0] == "diff":
+        # `tpusim trace diff A.jsonl B.jsonl`: compare two already-exported
+        # event logs instead of producing one.
+        return diff_main(argv[1:])
+
     p = build_parser()
     p.prog = "tpusim trace"
     p.description = "Run with the event flight recorder on and export the timeline."
@@ -204,8 +337,9 @@ def main(argv: list[str] | None = None) -> int:
         "reported); default: the config file's flight_capacity, else 1024",
     )
     p.add_argument(
-        "--trace-out", type=Path, default=Path("flight.trace.json"),
-        help="Perfetto / chrome-trace JSON output (load in ui.perfetto.dev)",
+        "--trace-out", type=Path, default=None,
+        help="Perfetto / chrome-trace JSON output (load in ui.perfetto.dev; "
+        "default flight.trace.json)",
     )
     p.add_argument(
         "--events-out", type=Path, default=None,
@@ -213,13 +347,40 @@ def main(argv: list[str] | None = None) -> int:
     )
     args = p.parse_args(argv)
     if args.backend == "cpp":
-        raise SystemExit(
-            "error: tpusim trace records on the JAX engines; the cpp backend "
-            "is the DIFF TARGET — produce its event log separately and diff "
-            "against --events-out"
-        )
+        # The native producer (native/simcore.cpp simcore_run_events): the
+        # oracle side of the diff recipe, same JSONL schema, no JAX import.
+        if args.events_out is None:
+            raise SystemExit(
+                "error: --backend cpp emits the JSONL event log only; "
+                "pass --events-out"
+            )
+        if args.trace_out is not None:
+            raise SystemExit(
+                "error: --trace-out renders the device flight ring; the cpp "
+                "producer writes the diffable event log only (--events-out)"
+            )
+        if args.flight_capacity is not None:
+            raise SystemExit(
+                "error: --flight-capacity sizes the device ring; the native "
+                "producer keeps every event"
+            )
+        try:
+            config = config_from_args(args)
+        except ValueError as e:
+            raise SystemExit(f"error: {e}") from None
+        from .backend.cpp import run_events_cpp
+
+        n_events = run_events_cpp(config, args.events_out)
+        if not args.quiet:
+            print(
+                f"[trace] native backend wrote {n_events} events from "
+                f"{config.runs} run(s) -> {args.events_out}"
+            )
+        return 0
     if args.flight_capacity is not None and args.flight_capacity < 1:
         raise SystemExit("error: --flight-capacity must be >= 1 for tracing")
+    if args.trace_out is None:
+        args.trace_out = Path("flight.trace.json")
     try:
         config = config_from_args(args)
     except ValueError as e:

@@ -591,6 +591,12 @@ class PallasEngine(Engine):
     """Engine with the per-chunk execution replaced by the VMEM-resident
     Pallas kernel. Same host loop, same init/finalize, same draws — the
     outputs are bit-identical to the scan engine on any supported config.
+    "Same finalize" carries the streaming-moment telemetry with it: the
+    per-run statistic leaves (including ``blocks_found_per_run``) come from
+    the one shared ``finalize_fn``, so the ``stats_*`` moment keys are
+    bit-equal scan-vs-pallas by construction, and a tile-misaligned batch's
+    head/tail split merges them exactly through ``combine_sums``'s additive
+    int64 rule (pinned by tests/test_convergence.py).
     Single-controller device meshes shard the batch's runs axis and run the
     kernel on every device (run-level parallelism of reference
     main.cpp:195-220 at kernel speed); multi-controller meshes and

@@ -5,9 +5,15 @@ Two input kinds, auto-detected:
   * a telemetry **JSONL file** written by ``--telemetry`` (tpusim.telemetry):
     rendered into a terminal/markdown dashboard — phase breakdown, steady-
     state throughput (the same derivation as ``Profiler.report``:
-    telemetry.throughput_report), a pipelined-dispatch stall histogram, and
+    telemetry.throughput_report; single-batch ledgers render a flagged
+    compile-contaminated estimate), a pipelined-dispatch stall histogram,
     the device-side simulation counters (max reorg depth, stale events,
-    active-step occupancy) aggregated over every batch span;
+    active-step occupancy) aggregated over every batch span, and — when the
+    ledger carries the runner's per-batch ``stats`` spans
+    (tpusim.convergence) — the convergence panels: final CI half-widths per
+    statistic, the ETA-to-target extrapolation, and the CI-narrowing
+    trajectory across batches. ``tpusim watch`` is this dashboard's live
+    twin for a still-growing ledger;
   * an XLA **trace directory** written by ``--trace-dir``: offline op-level
     time attribution from the chrome-trace JSON inside — no TensorBoard
     needed (absorbed from the former scripts/trace_report.py; that script is
@@ -32,7 +38,7 @@ from typing import Any
 
 from .telemetry import BatchRecord, load_spans, throughput_report
 
-__all__ = ["render_report", "trace_attribution", "main"]
+__all__ = ["render_report", "trace_attribution", "text_table", "main"]
 
 
 # ---------------------------------------------------------------------------
@@ -45,6 +51,20 @@ _STALL_BUCKETS = (0.001, 0.01, 0.1, 1.0, 10.0)
 
 def _fmt_s(s: float) -> str:
     return f"{s * 1e3:.1f} ms" if s < 1.0 else f"{s:.2f} s"
+
+
+def text_table(headers: list[str], rows: list[list[str]]) -> list[str]:
+    """Column-aligned plain-text table lines — the one text renderer behind
+    this dashboard's tables AND `tpusim watch`'s (which imports it), so the
+    two surfaces keep one look."""
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+    out = ["  " + "  ".join(h.ljust(w) for h, w in zip(headers, widths))]
+    for r in rows:
+        out.append("  " + "  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    return out
 
 
 def _bar(count: int, peak: int, width: int = 24) -> str:
@@ -141,13 +161,7 @@ def render_report(spans: list[dict], fmt: str = "text") -> str:
             for r in rows:
                 out.append("| " + " | ".join(r) + " |")
         else:
-            widths = [
-                max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
-                for i, h in enumerate(headers)
-            ]
-            out.append("  " + "  ".join(h.ljust(w) for h, w in zip(headers, widths)))
-            for r in rows:
-                out.append("  " + "  ".join(c.ljust(w) for c, w in zip(r, widths)))
+            out.extend(text_table(headers, rows))
 
     if not spans:
         return "telemetry ledger is empty (no parseable spans)\n"
@@ -220,6 +234,14 @@ def render_report(spans: list[dict], fmt: str = "text") -> str:
                 ["metric", "value"],
                 [[k, json.dumps(v)] for k, v in rep.items()],
             )
+            if rep.get("steady_is_first_batch"):
+                # A single-batch ledger has only compile-contaminated
+                # numbers; render them flagged in prose, not merely as a
+                # table row someone has to know to look for.
+                out.append(
+                    "  single-batch ledger: the steady-state rows above reuse "
+                    "the compile-contaminated first batch"
+                )
 
         stalls = [
             float(sp["attrs"]["stall_s"])
@@ -276,6 +298,57 @@ def render_report(spans: list[dict], fmt: str = "text") -> str:
                         for d, c in enumerate(rdh)
                     ],
                 )
+
+    sstats = [sp for sp in spans if sp["span"] == "stats"]
+    if sstats:
+        # Convergence panels (the per-batch `stats` spans of
+        # tpusim.convergence): final CI state + the narrowing trajectory.
+        # Grouped per run_id like throughput — an appended ledger (or a
+        # sweep, which shares one run_id across points) renders each
+        # segment's own trajectory; a run-count drop inside one group marks
+        # a new accumulator (next sweep point).
+        from .convergence import format_num, snapshot_rows
+
+        sgroups: dict[str, list[dict]] = {}
+        for sp in sstats:
+            sgroups.setdefault(sp.get("run_id", "?"), []).append(sp)
+        for rid, group in sgroups.items():
+            a = group[-1].get("attrs") or {}
+            heading(
+                "Convergence (stats spans)" if len(sgroups) == 1
+                else f"Convergence — run {rid}"
+            )
+            line = f"{a.get('runs', '?')} runs folded"
+            if a.get("runs_done") is not None and a.get("runs_done") != a.get("runs"):
+                line += f" (run at {a['runs_done']} incl. resumed checkpoint)"
+            if a.get("runs_total"):
+                line += f" of {a['runs_total']} planned"
+            if a.get("target_rel_hw") is not None:
+                line += f"; target rel half-width {format_num(a['target_rel_hw'])}"
+            if a.get("rate_is_first_batch"):
+                line += "; ETA rate from the compile-contaminated first batch"
+            out.append("  " + line)
+            table(
+                ["stat", "rel hw95 (worst miner)", "hw95 (max)", "eta to target"],
+                snapshot_rows(a.get("stats") or {}),
+            )
+
+            heading(
+                "CI narrowing (rel half-width vs batch)" if len(sgroups) == 1
+                else f"CI narrowing — run {rid}"
+            )
+            stat_names = list(a.get("stats") or {})
+            traj = []
+            for sp in group:
+                sa = sp.get("attrs") or {}
+                row = [str(sa.get("runs", "?"))]
+                for stat in stat_names:
+                    entry = (sa.get("stats") or {}).get(stat)
+                    if not isinstance(entry, dict):  # foreign/partial entry
+                        entry = {}
+                    row.append(format_num(entry.get("rel_hw_max")))
+                traj.append(row)
+            table(["runs", *stat_names], traj)
 
     faults = [sp for sp in spans if sp["span"] == "chaos"]
     if faults:

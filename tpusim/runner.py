@@ -30,6 +30,7 @@ from jax.sharding import Mesh
 
 from .chaos import ChaosInjector, ChaosPermanentError, as_injector
 from .config import SimConfig
+from .convergence import MomentAccumulator
 from .engine import Engine
 from .profiling import Profiler
 from .stats import SimResults
@@ -231,6 +232,7 @@ def run_simulation_config(
     step_block: int | None = None,
     engine_cache: dict | None = None,
     chaos=None,
+    ci_target_rel: float = 0.01,
 ) -> SimResults:
     """Run ``config.runs`` simulations and aggregate their statistics.
 
@@ -271,6 +273,20 @@ def run_simulation_config(
     (dispatch, checkpoint I/O, telemetry writes, the pipelined fetch); every
     injected fault lands as a ``chaos`` telemetry span. None (the default)
     leaves every seam a no-op check.
+
+    **Streaming convergence telemetry** — each batch's exact int64 moment
+    keys (``stats_*``, tpusim.convergence) are folded into a run-scoped
+    :class:`~tpusim.convergence.MomentAccumulator` and, when ``telemetry``
+    is set, emitted as one ``stats`` span per batch: running mean / standard
+    error / 95 % CI half-width per (statistic, miner), the worst relative
+    half-width, and an ETA extrapolation toward ``ci_target_rel`` (default
+    1 % relative half-width) at the measured steady run rate — flagged
+    ``rate_is_first_batch`` while the only measured batch is the
+    compile-contaminated first one, mirroring ``steady_is_first_batch``.
+    Render live with ``tpusim watch``; like the ``tele_`` counters, moments
+    are session-scoped (a checkpoint resume restarts them) and
+    multi-controller meshes emit none. This is the estimator substrate the
+    ROADMAP's adaptive-precision driver consumes.
     """
     if engine not in ("auto", "pallas", "scan"):
         raise ValueError(f"unknown engine {engine!r}; use auto, pallas or scan")
@@ -367,6 +383,11 @@ def run_simulation_config(
     tele_run = {"reorg_depth_max": 0, "stale_events": 0, "active_steps": 0,
                 "step_slots": 0, "retries": 0}
     hist_run = {"stale_by_miner": None, "reorg_depth_hist": None}
+    # Streaming convergence state: exact moment fold + the post-compile run
+    # rate the ETA extrapolation divides by (batch 0 carries the jit compile,
+    # so it is excluded — the steady_is_first_batch discipline).
+    moments = MomentAccumulator()
+    steady_rate = {"runs": 0, "s": 0.0}
 
     def finalize_with_retries(fin, this_engine, keys, start: int):
         """Block on an async batch and apply the retry/fallback policy; a
@@ -512,11 +533,19 @@ def run_simulation_config(
             # them through the telemetry ledger instead.
             tele_b = {k: batch_sums.pop(k) for k in list(batch_sums)
                       if k.startswith("tele_")}
+            # Streaming-moment keys (tpusim.convergence): telemetry like the
+            # tele_ counters, stripped from the stat/checkpoint path (the
+            # checkpoint schema is unchanged; a resume restarts the
+            # accumulator) and folded into the run-scoped estimator.
+            stats_b = {k: batch_sums.pop(k) for k in list(batch_sums)
+                       if k.startswith("stats_")}
             # Flight-recorder rows (if the config enabled recording) are
             # event logs, not statistics: drop them from the sum/checkpoint
             # path — `tpusim trace` is their collection pipeline.
             for k in [k for k in batch_sums if k.startswith("flight_")]:
                 del batch_sums[k]
+            if stats_b:
+                moments.add(stats_b)
             if tele_b:
                 step_slots = (
                     int(tele_b["tele_chunks_max"]) * eng_p.chunk_steps * nb
@@ -551,6 +580,35 @@ def run_simulation_config(
                         reorg_depth_hist=tele_b["tele_reorg_depth_hist_sum"].tolist(),
                     )
                 telemetry.emit("batch", t_start=time.time() - dur, dur_s=dur, **attrs)
+            if compile_s is not None:
+                # Post-compile batches only: batch 0's wall time is jit
+                # compile + execution, and a rate fit through it would put
+                # the ETA off by the compile-to-compute ratio.
+                steady_rate["runs"] += nb
+                steady_rate["s"] += now - last_done
+            if telemetry is not None and stats_b:
+                rate_is_first_batch = steady_rate["s"] <= 0.0
+                rate = (
+                    steady_rate["runs"] / steady_rate["s"]
+                    if not rate_is_first_batch
+                    else nb / max(now - last_done, 1e-9)
+                )
+                telemetry.emit(
+                    # runs = the accumulator's session scope (what the CI
+                    # numbers derive from); runs_done = the run-level
+                    # cumulative INCLUDING a resumed checkpoint's base, so
+                    # progress displays stay truthful after a resume.
+                    "stats", runs=moments.n, runs_done=runs_done + nb,
+                    runs_total=config.runs,
+                    duration_ms=config.duration_ms,
+                    block_interval_s=config.network.block_interval_s,
+                    target_rel_hw=ci_target_rel,
+                    rate_runs_per_s=round(rate, 3),
+                    rate_is_first_batch=rate_is_first_batch,
+                    stats=moments.snapshot(
+                        target_rel_hw=ci_target_rel, rate_runs_per_s=rate
+                    ),
+                )
             last_done = now
             if compile_s is None:
                 compile_s = now - t0

@@ -7,7 +7,8 @@ needs two correlated layers instead, and this module is the single host-side
 sink for both:
 
   * **Structured spans** — every batch, sweep point, checkpoint save/load,
-    retry and pipelined-dispatch stall is one JSONL line
+    retry, pipelined-dispatch stall and per-batch convergence snapshot
+    (the ``stats`` spans of tpusim.convergence) is one JSONL line
     ``{"run_id", "span", "t_start", "dur_s", "attrs"}`` written by
     :class:`TelemetryRecorder`. One ``run_id`` correlates every span of a
     run (and every point of a sweep), so a ledger can be grepped, joined

@@ -1,0 +1,229 @@
+"""``tpusim watch`` — live terminal dashboard over a telemetry JSONL ledger.
+
+The ``tpusim report`` dashboard is a post-mortem; this is the during-mortem
+twin: point it at the ledger a running ``--telemetry`` simulation (or sweep)
+is appending to, and it re-renders throughput, per-statistic CI narrowing
+(the ``stats`` spans of tpusim.convergence), occupancy and the fault ledger
+every few seconds until the run's closing span lands.
+
+    python -m tpusim watch artifacts/telemetry/run.jsonl            # live
+    python -m tpusim watch --once artifacts/telemetry/run.jsonl     # snapshot
+
+Deliberately jax-free: it imports no backend, so it starts instantly on the
+same (busy) host, inside a dying SSH window, or in CI — ``--once`` renders
+one snapshot and exits, which is the dead-terminal and smoke-test mode
+(scripts/ci.sh). Reading is crash-tolerant by construction: it re-parses the
+whole ledger each refresh through ``telemetry.load_spans``, which skips the
+torn line a concurrently-writing run may have in flight.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+from .convergence import format_num, snapshot_rows
+from .report import text_table
+from .telemetry import BatchRecord, load_spans, throughput_report
+
+__all__ = ["render_watch", "main"]
+
+#: ANSI clear-screen + home: the live loop repaints in place.
+_CLEAR = "\x1b[2J\x1b[H"
+
+
+def _bar(frac: float, width: int = 28) -> str:
+    frac = min(max(frac, 0.0), 1.0)
+    n = round(width * frac)
+    return "[" + "#" * n + "." * (width - n) + "]"
+
+
+def render_watch(spans: list[dict], source: str, now: float | None = None) -> str:
+    """One full dashboard frame for the ledger's CURRENT state. Ledgers can
+    hold several runs (appended files, sweeps): panels follow the most
+    recent ``run_id``, and the header says how many others there are."""
+    if now is None:
+        now = time.time()
+    out: list[str] = [f"tpusim watch — {source}"]
+    if not spans:
+        out.append("  (no parseable spans yet — waiting for the run to emit)")
+        return "\n".join(out) + "\n"
+
+    run_ids = [sp.get("run_id", "?") for sp in spans]
+    rid = run_ids[-1]
+    mine = [sp for sp in spans if sp.get("run_id", "?") == rid]
+    n_other = len(set(run_ids)) - 1
+    last_t = max(sp.get("t_start", 0.0) + sp.get("dur_s", 0.0) for sp in mine)
+    completed = any(sp["span"] == "run" for sp in mine)
+    head = (
+        f"run_id {rid}"
+        + (f" (+{n_other} earlier in this ledger)" if n_other else "")
+        + f" · {len(mine)} spans · last span {max(now - last_t, 0.0):.1f} s ago"
+        + f" · {'COMPLETED' if completed else 'RUNNING'}"
+    )
+    out.append(head)
+
+    batches = [sp for sp in mine if sp["span"] == "batch"]
+    sstats = [sp for sp in mine if sp["span"] == "stats"]
+    last_stats = (sstats[-1].get("attrs") or {}) if sstats else {}
+
+    # --- Progress + throughput. runs_done is the RUN-level cumulative
+    # (checkpoint-resumed base included); `runs` is the session-scoped
+    # accumulator count and would understate a resumed run's progress.
+    runs_done = last_stats.get("runs_done", last_stats.get("runs"))
+    runs_total = last_stats.get("runs_total")
+    if runs_done is None and batches:
+        runs_done = sum(int((sp.get("attrs") or {}).get("runs", 0)) for sp in batches)
+    if runs_done is not None:
+        line = f"runs {runs_done}"
+        if runs_total:
+            line += (
+                f"/{runs_total} ({100.0 * runs_done / runs_total:.1f}%)  "
+                + _bar(runs_done / runs_total)
+            )
+        out.append(line)
+    if batches:
+        records = [
+            BatchRecord(
+                int((sp.get("attrs") or {}).get("runs", 0)),
+                float(sp.get("dur_s", 0.0)),
+            )
+            for sp in batches
+        ]
+        # duration_ms rides every stats span, so sim-rate is derivable
+        # mid-run; a foreign ledger without one still gets run-rate.
+        if "duration_ms" in last_stats:
+            rep = throughput_report(
+                records, int(last_stats["duration_ms"]),
+                float(last_stats.get("block_interval_s", 600.0)),
+            )
+        else:
+            rep = throughput_report(records, 0, 600.0)
+            rep.pop("steady_sim_years_per_s", None)
+            rep.pop("steady_events_per_s", None)
+        line = (
+            f"throughput {rep['steady_runs_per_s']} runs/s"
+            + (
+                f" · {rep['steady_sim_years_per_s']} sim-years/s"
+                if "steady_sim_years_per_s" in rep else ""
+            )
+            + f" · {rep['batches']} batch(es), first {rep['first_batch_s']} s (compile)"
+        )
+        if rep.get("steady_is_first_batch"):
+            # The steady_is_first_batch discipline: never pass the compile
+            # batch off as steady state without saying so.
+            line += " · SINGLE BATCH — compile-contaminated estimate"
+        out.append(line)
+        active = sum(int((sp.get("attrs") or {}).get("active_steps", 0)) for sp in batches)
+        slots = sum(int((sp.get("attrs") or {}).get("step_slots", 0)) for sp in batches)
+        retries = sum(int((sp.get("attrs") or {}).get("retries", 0)) for sp in batches)
+        occ = f"{active / slots:.3f}" if slots else "n/a"
+        out.append(f"occupancy {occ} · retries {retries}")
+
+    # --- Convergence (the stats spans this dashboard exists for).
+    out.append("")
+    if sstats:
+        target = last_stats.get("target_rel_hw")
+        title = f"convergence (95% CI, n={last_stats.get('runs', '?')}"
+        if target is not None:
+            title += f", target rel hw {format_num(target)}"
+        if last_stats.get("rate_is_first_batch"):
+            title += ", rate from first batch — compile-contaminated"
+        out.append(title + "):")
+        per_stat: dict = last_stats.get("stats") or {}
+        rows = snapshot_rows(per_stat)
+        out.extend(text_table(["stat", "rel hw (worst miner)", "hw95 (max)", "eta to target"], rows))
+        # Narrowing trend: first -> latest worst relative half-width. A
+        # growing n with a shrinking rel hw is the 1/sqrt(n) signature;
+        # anything else is worth staring at.
+        trends = []
+        first_stats = (sstats[0].get("attrs") or {}).get("stats") or {}
+        for stat, entry in per_stat.items():
+            first = first_stats.get(stat)
+            if not isinstance(entry, dict) or not isinstance(first, dict):
+                continue
+            a = first.get("rel_hw_max")
+            b = entry.get("rel_hw_max")
+            # isinstance, not truthiness: a foreign ledger's string value
+            # must render as "no trend", not crash the frame.
+            if isinstance(a, (int, float)) and isinstance(b, (int, float)) and a and b > 0:
+                trends.append(f"{stat} x{a / b:.2f}")
+        if len(sstats) > 1 and trends:
+            out.append(
+                f"  narrowing over {len(sstats)} batches: " + ", ".join(trends)
+            )
+    else:
+        out.append("convergence: no stats spans yet (run with --telemetry on a "
+                   "tpusim version that emits them)")
+
+    # --- Fault ledger.
+    faults = [sp for sp in mine if sp["span"] == "chaos"]
+    if faults:
+        last = faults[-1].get("attrs") or {}
+        out.append(
+            f"fault ledger: {len(faults)} injected fault(s), last "
+            f"{last.get('point', '?')}/{last.get('kind', '?')}"
+        )
+    return "\n".join(out) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpusim watch",
+        description="Live terminal dashboard over a --telemetry JSONL ledger.",
+    )
+    ap.add_argument("path", type=Path, help="telemetry .jsonl ledger to tail")
+    ap.add_argument(
+        "--once", action="store_true",
+        help="render one snapshot and exit (CI / dead-terminal mode)",
+    )
+    ap.add_argument(
+        "--interval", type=float, default=2.0, metavar="S",
+        help="refresh period in seconds (default 2.0)",
+    )
+    ap.add_argument(
+        "--follow", action="store_true",
+        help="keep watching after the run's closing span (default: exit then)",
+    )
+    ap.add_argument(
+        "--no-clear", action="store_true",
+        help="append frames instead of repainting (dumb terminals / logs)",
+    )
+    args = ap.parse_args(argv)
+
+    if args.once and not args.path.exists():
+        print(f"error: {args.path} does not exist", file=sys.stderr)
+        return 2
+    try:
+        while True:
+            spans = load_spans(args.path) if args.path.exists() else []
+            frame = render_watch(spans, str(args.path))
+            if not args.once and not args.no_clear:
+                sys.stdout.write(_CLEAR)
+            try:
+                print(frame, end="", flush=True)
+            except BrokenPipeError:
+                return 0  # `tpusim watch --once | head` is not an error
+            if args.once:
+                return 0
+            if spans and not args.follow:
+                # Exit when the run the panels follow (the ledger's newest
+                # run_id — an appended file may hold earlier completed runs)
+                # has emitted its closing span; the final frame is already
+                # on screen.
+                rid = spans[-1].get("run_id", "?")
+                if any(
+                    sp.get("span") == "run" and sp.get("run_id", "?") == rid
+                    for sp in spans
+                ):
+                    return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        print()
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
