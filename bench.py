@@ -158,10 +158,17 @@ def pipelined_measure(engine, key_fn, batch: int, budget_s: float,
         inflight.append(engine.run_batch_async(key_fn(i)))
         while len(inflight) > depth:
             finalize_one()
+        # tpusim-lint: disable=JX009 -- deliberately unforced mid-pipeline
+        # budget check: the sync lives inside the popped finalize callable
+        # (np.asarray of the batch sums), and blocking here would serialize
+        # the pipeline this loop exists to measure.
         if time.perf_counter() - t0 >= budget_s:
             break
     while inflight:
         finalize_one()
+    # tpusim-lint: disable=JX009 -- the drain loop above finalized every
+    # in-flight batch (the finalize callable blocks on the stat transfer),
+    # so the device is idle by this read; the interval is a true wall time.
     return total_runs, time.perf_counter() - t0
 
 
@@ -190,6 +197,12 @@ def main() -> int:
                     help="append a structured span ledger here "
                          "(tpusim.telemetry; render with `tpusim report`): "
                          "phase spans plus one batch span per measured batch")
+    ap.add_argument("--perf-ledger", default=None, metavar="JSONL",
+                    help="append the headline/exact payloads as perf-ledger "
+                         "rows in the shared tpusim.perf schema (default: "
+                         "artifacts/perf/perf_<platform>.jsonl; 'none' "
+                         "disables) — BENCH history and the `tpusim perf` "
+                         "ledger are one format")
     ap.add_argument("--ablate", type=int, default=0, metavar="N_CHUNKS",
                     help="instead of the headline, time N>=12 chained chunks "
                          "inside one jit per engine (the canonical "
@@ -611,6 +624,50 @@ def main() -> int:
                 append_perf_rows(
                     rows, "bench.py end-to-end headline (incl. dispatch)"
                 )
+        # Shared-schema perf-ledger rows (tpusim.perf) on EVERY platform: the
+        # same append-only file `tpusim perf run` writes, so `perf report`
+        # shows the end-to-end headline trajectory next to the chained-chunk
+        # kernel rows and `perf compare` can gate either. Best-effort — the
+        # ledger is evidence, not the stdout JSON contract.
+        if args.perf_ledger != "none":
+            try:
+                from tpusim.perf import append_rows, default_ledger_path, perf_row
+
+                ledger = args.perf_ledger or str(default_ledger_path(platform))
+                perf_rows = [perf_row(
+                    "bench_headline_fast", "sim_years_per_s",
+                    round(sim_years_per_s, 3), unit="sim-years/s",
+                    better="higher",
+                    shape={
+                        "engine": info["engine"], "mode": "fast",
+                        "batch_size": batch, "superstep": info["superstep"],
+                        "pipelined": info["pipelined"],
+                        "rng_batch": info["rng_batch"],
+                        "state_dtype": info["state_dtype"],
+                    },
+                    extra={"elapsed_s": round(elapsed, 2), "runs": total_runs},
+                )]
+                einfo = info.get("exact")
+                if einfo:
+                    perf_rows.append(perf_row(
+                        "bench_headline_exact", "sim_years_per_s",
+                        einfo["sim_years_per_s"], unit="sim-years/s",
+                        better="higher",
+                        shape={
+                            "engine": einfo["engine"], "mode": einfo["mode"],
+                            "batch_size": einfo["batch_size"],
+                            "superstep": einfo["superstep"],
+                            "pipelined": einfo["pipelined"],
+                            "rng_batch": einfo["rng_batch"],
+                            "state_dtype": einfo["state_dtype"],
+                        },
+                        extra={"elapsed_s": einfo["elapsed_s"],
+                               "runs": einfo["runs"]},
+                    ))
+                append_rows(ledger, perf_rows)
+                log(f"appended {len(perf_rows)} perf-ledger row(s) to {ledger}")
+            except Exception as e:  # noqa: BLE001 — see comment above
+                log(f"could not append perf-ledger rows: {e}")
         if recorder is not None:
             recorder.close()
         done.set()

@@ -98,6 +98,14 @@ from tpusim.telemetry import load_spans
 spans = load_spans(sys.argv[1])
 names = {s["span"] for s in spans}
 assert "batch" in names and "run" in names, names
+# Perf-observability smoke: a cold run MUST record its compiles (the
+# CompileLedger spans) and per-batch memory watermarks — a silently dead
+# compile listener or memory probe would otherwise stay green forever.
+assert "compile" in names, names
+batch = next(s for s in spans if s["span"] == "batch")
+assert batch["attrs"].get("mem_live_bytes", 0) > 0, batch["attrs"]
+run = next(s for s in spans if s["span"] == "run")
+assert run["attrs"].get("compiles", 0) > 0, run["attrs"]
 EOF
 env JAX_PLATFORMS=cpu python -m tpusim report "$tele_dir/smoke.jsonl" > /dev/null
 
@@ -110,6 +118,22 @@ echo "== watch --once smoke =="
 # bare "convergence" would also match the no-stats-spans fallback line and
 # let a dead stats pipeline slip through green.
 python -m tpusim watch --once "$tele_dir/smoke.jsonl" | grep -q "target rel hw"
+
+echo "== perf observability (regression ledger + noise gate) =="
+# The repo's canonical perf ritual as a command (tpusim.perf): a quick
+# chained-chunk run appends schema-validated ledger rows, and the
+# spread-aware compare gates them against the calibration baseline committed
+# from this container. Exit nonzero only on a regression beyond measured
+# noise — the margin floor is 50% because this 2-core host's quick min-of-3
+# shape still swings (the committed baseline's own spread is ~26%); a real
+# regression like the synthetic 2x pinned in tests/test_perf_obs.py clears
+# that floor either way.
+env JAX_PLATFORMS=cpu python -m tpusim.cli perf run --quick \
+  --out "$tele_dir/perf_quick.jsonl"
+env JAX_PLATFORMS=cpu python -m tpusim.cli perf compare \
+  artifacts/perf/calibration_cpu.jsonl "$tele_dir/perf_quick.jsonl" \
+  --min-margin 0.5
+python -m tpusim.cli perf report "$tele_dir/perf_quick.jsonl" > /dev/null
 
 echo "== flight-recorder trace smoke =="
 # One tiny flight-enabled run end-to-end: export the Perfetto trace + JSONL

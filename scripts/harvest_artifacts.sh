@@ -55,4 +55,19 @@ for path in sys.argv[1:]:
     print(f"[harvest] {path}: {validate_perfetto(json.load(open(path)))} events")
 EOF
 fi
+# Perf regression ledgers (`tpusim perf run` / bench.py append here; TPU
+# windows rsync theirs back next to the telemetry ledgers): schema-validate
+# every collected row so a malformed producer can't silently poison the
+# baseline the CI noise gate compares against. Strict by design — a bad row
+# fails the harvest, exactly like a corrupt trace. jax-free (tpusim.perf
+# imports no backend for loading/validation).
+perf_ledgers=$(ls artifacts/perf/*.jsonl 2>/dev/null || true)
+if [ -n "$perf_ledgers" ]; then
+  python - $perf_ledgers <<'EOF'
+import sys
+from tpusim.perf import load_rows
+for path in sys.argv[1:]:
+    print(f"[harvest] {path}: {len(load_rows(path))} perf rows OK")
+EOF
+fi
 git status --short BASELINE.json REFSCALE.md artifacts/

@@ -22,6 +22,7 @@ CFG = LintConfig(
     hot_modules=("hot.py",),
     device_modules=("device.py",),
     unused_globs=("scripts/*.py",),
+    measurement_modules=("bench_like.py",),
 )
 
 
@@ -640,6 +641,103 @@ def test_jx008_seeded_and_clean():
     assert lint(_JX008_CLEAN, path="scripts/tool.py") == []
     # Package modules are out of scope: public API is invisible reachability.
     assert lint(_JX008_BAD, path="mod.py") == []
+
+
+# ---------------------------------------------------------------------------
+# JX009 — unblocked timing (measurement modules only).
+
+_JX009_BAD = """
+    import time
+
+    def measure(engine, keys):
+        t0 = time.perf_counter()
+        out = engine.run_batch_async(keys)
+        return time.perf_counter() - t0
+"""
+
+_JX009_CLEAN = """
+    import time
+
+    def measure(engine, keys):
+        t0 = time.perf_counter()
+        out = engine.run_batch_async(keys)
+        out().block_until_ready()
+        return time.perf_counter() - t0
+"""
+
+#: Synchronous timing: no device-dispatch call in the bracket at all.
+_JX009_CLEAN_SYNC = """
+    import time
+
+    def measure(engine, keys):
+        t0 = time.perf_counter()
+        out = engine.run_batch(keys)
+        return time.perf_counter() - t0
+"""
+
+#: Module top level is a timed scope too (benchmark scripts time inline).
+_JX009_BAD_TOPLEVEL = """
+    import time
+
+    t0 = time.monotonic()
+    out = eng._run_device(keys, hi, lo, params)
+    elapsed = time.monotonic() - t0
+"""
+
+#: The delta's left side may be a name holding a later clock reading.
+_JX009_BAD_NAMED_NOW = """
+    import time
+
+    def measure(engine, keys):
+        t0 = time.perf_counter()
+        engine.run_batch_async(keys)
+        now = time.perf_counter()
+        dur = now - t0
+        return dur
+"""
+
+#: A re-mark between the dispatch and the delta narrows the bracket: the
+#: dispatch is OUTSIDE the re-marked interval.
+_JX009_CLEAN_REMARK = """
+    import time
+
+    def measure(engine, keys):
+        t0 = time.perf_counter()
+        fin = engine.run_batch_async(keys)
+        result = fin()
+        t0 = time.perf_counter()
+        host_work(result)
+        return time.perf_counter() - t0
+"""
+
+
+def test_jx009_seeded_and_clean():
+    found = lint(_JX009_BAD, path="bench_like.py")
+    assert rules_of(found) == {"JX009"}
+    assert "run_batch_async" in found[0].message
+    assert lint(_JX009_CLEAN, path="bench_like.py") == []
+    assert lint(_JX009_CLEAN_SYNC, path="bench_like.py") == []
+    assert lint(_JX009_CLEAN_REMARK, path="bench_like.py") == []
+    found = lint(_JX009_BAD_TOPLEVEL, path="bench_like.py")
+    assert rules_of(found) == {"JX009"}
+    found = lint(_JX009_BAD_NAMED_NOW, path="bench_like.py")
+    assert rules_of(found) == {"JX009"}
+
+
+def test_jx009_scoped_to_measurement_modules():
+    """Orchestration code times unforced intervals deliberately (pipelined
+    stall accounting); the rule must stay inside the measurement set."""
+    assert lint(_JX009_BAD, path="mod.py") == []
+    assert lint(_JX009_BAD, path="hot.py") == []
+
+
+def test_jx009_suppression():
+    src = _JX009_BAD.replace(
+        "return time.perf_counter() - t0",
+        "return time.perf_counter() - t0  "
+        "# tpusim-lint: disable=JX009 -- sync lives in a callable",
+    )
+    assert lint(src, path="bench_like.py") == []
 
 
 # ---------------------------------------------------------------------------

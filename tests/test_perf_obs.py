@@ -1,0 +1,424 @@
+"""Performance observability: the CompileLedger's `compile` spans against
+compile_count_guard across cold/warmed/pipelined dispatch, per-batch memory
+watermark attrs, the zero-hot-path-overhead pin (jaxpr byte-identical with
+telemetry armed), and the `tpusim perf` ledger schema + spread-aware noise
+gate (self-vs-self passes, a synthetic 2x regression fails).
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import pytest
+
+from tpusim import perf
+from tpusim.config import SimConfig, default_network
+from tpusim.engine import Engine
+from tpusim.runner import make_engine, run_simulation_config
+from tpusim.telemetry import (
+    CompileLedger,
+    TelemetryRecorder,
+    device_memory_attrs,
+    load_spans,
+)
+from tpusim.testing import compile_count_guard
+
+SMALL = SimConfig(
+    network=default_network(propagation_ms=1000),
+    duration_ms=86_400_000,
+    runs=8,
+    batch_size=4,
+    seed=3,
+)
+
+
+def _compile_spans(path) -> list[dict]:
+    # The recorder opens its file lazily: in a warmed full-suite process the
+    # eager-op caches mean zero compiles may have fired yet, so no file is
+    # a valid "no spans yet" state, not an error.
+    if not path.exists():
+        return []
+    return [s for s in load_spans(path) if s["span"] == "compile"]
+
+
+# ---------------------------------------------------------------------------
+# Compile spans vs. the guard.
+
+
+def test_compile_spans_agree_with_guard_across_dispatch_paths(tmp_path):
+    """The observability half (CompileLedger spans) and the assertion half
+    (compile_count_guard) ride the SAME listener, so their counts must agree
+    event-for-event: cold dispatch emits exactly as many spans as the guard
+    counts, warmed dispatch emits none — on both the device-loop and the
+    pipelined path."""
+    path = tmp_path / "t.jsonl"
+    rec = TelemetryRecorder(path)
+    ledger = CompileLedger(rec).install()
+    try:
+        # The ledger is session-scoped: engine construction and key building
+        # compile helper programs too, and every one must land as a span —
+        # so the guard comparison is on the DELTA around each guarded block.
+        eng = Engine(SMALL)
+        keys = eng.make_keys(0, 8)
+        assert len(_compile_spans(path)) == ledger.compiles
+
+        n0 = len(_compile_spans(path))
+        with compile_count_guard() as cold:
+            eng.run_batch(keys)
+        assert cold.count > 0
+        assert len(_compile_spans(path)) - n0 == cold.count
+
+        n1 = len(_compile_spans(path))
+        with compile_count_guard(exact=0):
+            eng.run_batch(keys)
+        assert len(_compile_spans(path)) == n1  # warmed: no new spans
+
+        with compile_count_guard() as pipe_cold:
+            eng.run_batch(keys, pipelined=True)
+        assert pipe_cold.count > 0  # the donating _pipe_chunk program
+        assert len(_compile_spans(path)) - n1 == pipe_cold.count
+
+        n2 = len(_compile_spans(path))
+        with compile_count_guard(exact=0):
+            eng.run_batch(keys, pipelined=True)
+        assert len(_compile_spans(path)) == n2
+    finally:
+        ledger.uninstall()
+        rec.close()
+    # Uninstalled: further compiles must not reach this recorder's ledger.
+    n_before = len(_compile_spans(path))
+    Engine(SMALL).run_batch(Engine(SMALL).make_keys(0, 8))
+    assert len(_compile_spans(path)) == n_before
+
+
+def test_compile_ledger_context_and_cache_events(tmp_path):
+    path = tmp_path / "t.jsonl"
+    rec = TelemetryRecorder(path)
+    ledger = CompileLedger(rec).install()
+    try:
+        ledger.set_context(dispatch="unit-test", engine="Engine")
+        cache: dict = {}
+        e1 = make_engine(SMALL, cache=cache, compile_ledger=ledger)
+        e2 = make_engine(SMALL, cache=cache, compile_ledger=ledger)
+        assert e2 is e1  # same reuse_key: the hit rebinds the same object
+        assert ledger.cache_hits == 1 and ledger.cache_misses == 1
+        e1.run_batch(e1.make_keys(0, 8))
+    finally:
+        ledger.uninstall()
+        rec.close()
+    spans = load_spans(path)
+    cache_spans = [s for s in spans if s["span"] == "engine_cache"]
+    assert [s["attrs"]["hit"] for s in cache_spans] == [False, True]
+    comp = _compile_spans(path)
+    assert comp and all(
+        s["attrs"]["dispatch"] == "unit-test" and s["attrs"]["engine"] == "Engine"
+        for s in comp
+    )
+    summary = ledger.summary_attrs()
+    assert summary["compiles"] == len(comp)
+    assert summary["compile_span_s"] >= 0.0
+    assert summary["engine_cache_hits"] == 1
+    assert summary["engine_cache_misses"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Memory attrs.
+
+
+def test_device_memory_attrs_present_and_sane():
+    import jax.numpy as jnp
+
+    anchor = jnp.arange(1024, dtype=jnp.int32)
+    attrs = device_memory_attrs()
+    assert attrs["mem_live_buffers"] >= 1
+    # The watermark must at least cover the buffer we are provably holding.
+    assert attrs["mem_live_bytes"] >= anchor.nbytes
+    # Allocator stats are platform-optional (absent on CPU) — but when
+    # present they must be positive.
+    for key in ("mem_bytes_in_use", "mem_peak_bytes"):
+        if key in attrs:
+            assert attrs[key] > 0
+
+
+def test_engine_memory_attrs_models():
+    from tpusim.pallas_engine import VMEM_BUDGET, PallasEngine
+    from tpusim.profiling import state_bytes_per_run
+
+    eng = Engine(SMALL)
+    attrs = eng.memory_attrs()
+    assert attrs["state_bytes_per_run"] == state_bytes_per_run(eng)
+    assert attrs["state_bytes_per_run"] > 0
+    # The pallas engine adds its VMEM estimate vs. the guard's budget
+    # (interpret mode: CPU containers have no Mosaic).
+    cfg = SimConfig(
+        network=default_network(propagation_ms=1000),
+        duration_ms=86_400_000, runs=128, batch_size=128, seed=3,
+    )
+    peng = PallasEngine(cfg, interpret=True)
+    pattrs = peng.memory_attrs()
+    assert pattrs["vmem_est_bytes"] == peng.vmem_est > 0
+    assert pattrs["vmem_budget_bytes"] == VMEM_BUDGET
+    assert pattrs["state_bytes_per_run"] > 0
+
+
+def test_runner_batch_spans_carry_memory_and_run_span_totals(tmp_path):
+    path = tmp_path / "run.jsonl"
+    rec = TelemetryRecorder(path)
+    run_simulation_config(SMALL, use_all_devices=False, telemetry=rec)
+    rec.close()
+    spans = load_spans(path)
+    batches = [s for s in spans if s["span"] == "batch"]
+    assert batches
+    for sp in batches:
+        attrs = sp["attrs"]
+        assert attrs["mem_live_bytes"] > 0
+        assert attrs["mem_live_buffers"] >= 1
+        assert attrs["state_bytes_per_run"] > 0
+    run = next(s for s in spans if s["span"] == "run")["attrs"]
+    comp = _compile_spans(path)
+    assert run["compiles"] == len(comp) > 0
+    assert run["compile_span_s"] > 0.0
+    assert run["engine_cache_hits"] == 0 and run["engine_cache_misses"] == 0
+    # Context attribution: the compiles provoked by the first dispatch carry
+    # the dispatch path and the engine's reuse_key.
+    dispatched = [s for s in comp if s["attrs"].get("dispatch")]
+    assert dispatched and all(
+        s["attrs"]["dispatch"] == "run_batch_async" for s in dispatched
+    )
+    assert all("reuse_key" in s["attrs"] for s in dispatched)
+
+
+# ---------------------------------------------------------------------------
+# Zero hot-path overhead.
+
+
+def test_chunk_program_byte_identical_with_telemetry_armed(tmp_path):
+    """The perf-observability layer is host-side listeners and batch-boundary
+    probes ONLY: the device-loop program must be byte-identical with a
+    ledger armed, and warmed dispatch must stay at exactly zero compiles."""
+    keys = Engine(SMALL).make_keys(0, 8)
+
+    def loop_jaxpr() -> str:
+        eng = Engine(SMALL)
+        hi, lo = eng._ledger_init(8)
+        return str(jax.make_jaxpr(
+            lambda k: eng._device_loop(k, hi, lo, eng.params)
+        )(keys))
+
+    plain = loop_jaxpr()
+    rec = TelemetryRecorder(tmp_path / "armed.jsonl")
+    ledger = CompileLedger(rec).install()
+    try:
+        assert loop_jaxpr() == plain
+        eng = Engine(SMALL)
+        eng.run_batch(keys)
+        with compile_count_guard(exact=0):
+            eng.run_batch(keys)
+    finally:
+        ledger.uninstall()
+        rec.close()
+
+
+# ---------------------------------------------------------------------------
+# The perf ledger schema.
+
+
+def _row(value: float = 1.0, samples=None, scenario="chained_fast", **over):
+    row = perf.perf_row(
+        scenario, "s_per_chunk", value, unit="s/chunk",
+        samples=samples if samples is not None else [value, value * 1.02],
+        shape={"runs": 128, "n_chunks": 4, "chunk_steps": 256,
+               "superstep": 2, "engine": "Engine", "mode": "fast",
+               "rng_batch": True, "state_dtype": "int32"},
+    )
+    row.update(over)
+    return row
+
+
+def test_perf_row_schema_and_env_fingerprint():
+    row = _row()
+    perf.validate_row(row)  # must not raise
+    env = row["env"]
+    assert env["cpu_count"] >= 1
+    assert "date" in env
+    assert env["platform"] == "cpu"
+    # jax_version rides along so cross-host rows are self-describing.
+    assert env["jax_version"] == jax.__version__
+
+
+@pytest.mark.parametrize("mutate, match", [
+    (lambda r: r.pop("samples"), "missing required"),
+    (lambda r: r.update(schema=99), "schema"),
+    (lambda r: r.update(better="sideways"), "lower|higher"),
+    (lambda r: r.update(value="fast"), "number"),
+    (lambda r: r.update(samples=[]), "non-empty"),
+    (lambda r: r.update(samples=[1.0, "x"]), "number list"),
+    (lambda r: r.update(env="cpu"), "env"),
+])
+def test_validate_row_rejects(mutate, match):
+    row = _row()
+    mutate(row)
+    with pytest.raises(ValueError, match=match):
+        perf.validate_row(row)
+
+
+def test_append_load_roundtrip_and_strict_loader(tmp_path):
+    path = tmp_path / "ledger.jsonl"
+    rows = [_row(1.0), _row(2.0, scenario="chained_exact")]
+    perf.append_rows(path, rows)
+    assert perf.load_rows(path) == rows
+    # A torn line is corrupted evidence: the loader is strict, unlike
+    # telemetry.load_spans (nothing writes a perf ledger concurrently).
+    with path.open("a") as fh:
+        fh.write('{"schema": 1, "scenario": "torn...\n')
+    with pytest.raises(ValueError, match="unparseable"):
+        perf.load_rows(path)
+
+
+# ---------------------------------------------------------------------------
+# The noise gate.
+
+
+def _write(path, rows):
+    with open(path, "w") as fh:
+        for r in rows:
+            fh.write(json.dumps(r) + "\n")
+
+
+def test_compare_self_vs_self_passes(tmp_path):
+    a = tmp_path / "a.jsonl"
+    _write(a, [_row(1.0), _row(0.25, scenario="chained_exact")])
+    results = perf.compare_rows(perf.load_rows(a), perf.load_rows(a))
+    assert [r["status"] for r in results] == ["ok", "ok"]
+    assert perf.main(["compare", str(a), str(a)]) == 0
+
+
+def test_compare_flags_synthetic_2x_regression(tmp_path):
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    _write(a, [_row(1.0, samples=[1.0, 1.05, 1.1])])
+    _write(b, [_row(2.0, samples=[2.0, 2.1, 2.2])])
+    results = perf.compare_rows(perf.load_rows(a), perf.load_rows(b))
+    assert results[0]["status"] == "regression"
+    assert results[0]["ratio"] == pytest.approx(2.0)
+    assert perf.main(["compare", str(a), str(b)]) == 1
+    # The improvement direction must NOT fail the gate.
+    assert perf.main(["compare", str(b), str(a)]) == 0
+    results = perf.compare_rows(perf.load_rows(b), perf.load_rows(a))
+    assert results[0]["status"] == "improved"
+
+
+def test_compare_noise_model_widens_margin(tmp_path):
+    """A ratio past the floor but inside the measured sample spread is
+    noise, not a regression — the property that keeps the CI gate alive on
+    a noisy shared host without going blind to real regressions."""
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    _write(a, [_row(1.0, samples=[1.0, 1.6])])  # 60% measured spread
+    _write(b, [_row(1.4, samples=[1.4, 1.5])])
+    results = perf.compare_rows(perf.load_rows(a), perf.load_rows(b))
+    # margin = max(0.25, 2 * 0.6) = 1.2 > ratio-1 = 0.4
+    assert results[0]["status"] == "ok"
+    assert results[0]["margin"] == pytest.approx(1.2)
+    # The same ratio with tight samples IS a regression.
+    _write(a, [_row(1.0, samples=[1.0, 1.02])])
+    results = perf.compare_rows(perf.load_rows(a), perf.load_rows(b))
+    assert results[0]["status"] == "regression"
+
+
+def test_compare_refuses_empty_baseline(tmp_path):
+    """A truncated/empty baseline marks every candidate row 'new' and
+    compares NOTHING — that must fail the gate (exit 2), not turn it green
+    (artifacts/README.md tells operators to truncate before regenerating;
+    the half-done state must be loud)."""
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    a.write_text("")
+    _write(b, [_row(1.0)])
+    assert perf.main(["compare", str(a), str(b)]) == 2
+    b.write_text("")  # both empty: still nothing gated
+    assert perf.main(["compare", str(a), str(b)]) == 2
+
+
+def test_compile_ledger_uninstalled_on_setup_failure(tmp_path):
+    """A run that fails BETWEEN ledger install and the batch loop (here:
+    make_engine's tuning-override strictness) must still unsubscribe — a
+    leaked subscriber would narrate every later run's compiles into the
+    dead run's ledger with a stale run_id."""
+    from tpusim import testing as t
+
+    rec = TelemetryRecorder(tmp_path / "x.jsonl")
+    before = len(t._compile_subscribers)
+    with pytest.raises(ValueError, match="auto-routes"):
+        run_simulation_config(
+            SMALL, use_all_devices=False, telemetry=rec, tile_runs=256
+        )
+    rec.close()
+    assert len(t._compile_subscribers) == before
+
+
+def test_compare_refuses_missing_and_incomparable(tmp_path):
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    _write(a, [_row(1.0), _row(0.25, scenario="chained_exact")])
+    _write(b, [_row(1.0)])  # exact scenario missing from the candidate
+    assert perf.main(["compare", str(a), str(b)]) == 2
+    # Shape drift (different pinned runs) is a category error, not noise.
+    changed = _row(1.0)
+    changed["shape"]["runs"] = 512
+    _write(b, [changed, _row(0.25, scenario="chained_exact")])
+    results = perf.compare_rows(perf.load_rows(a), perf.load_rows(b))
+    by_scenario = {r["scenario"]: r for r in results}
+    assert by_scenario["chained_fast"]["status"] == "incomparable"
+    assert by_scenario["chained_exact"]["status"] == "ok"
+    assert perf.main(["compare", str(a), str(b)]) == 2
+
+
+def test_latest_row_per_scenario_wins(tmp_path):
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    _write(a, [_row(5.0), _row(1.0)])  # append-only: the NEWER row gates
+    _write(b, [_row(1.0)])
+    results = perf.compare_rows(perf.load_rows(a), perf.load_rows(b))
+    assert results[0]["status"] == "ok"
+    assert results[0]["base_value"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# perf run end-to-end (tiny shape) + CLI dispatch.
+
+
+def test_perf_run_compare_report_end_to_end(tmp_path):
+    """The CI leg's exact flow at a test-sized shape: run appends
+    schema-valid rows, self-compare passes the gate, report renders."""
+    out = tmp_path / "perf.jsonl"
+    rc = perf.main([
+        "run", "--quick", "--runs", "8", "--n-chunks", "2", "--repeats", "2",
+        "--scenarios", "fast", "--out", str(out),
+    ])
+    assert rc == 0
+    rows = perf.load_rows(out)
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["scenario"] == "chained_fast"
+    assert len(row["samples"]) == 2  # ALL samples recorded, not just best
+    assert row["value"] > 0
+    assert row["shape"]["runs"] == 8 and row["shape"]["n_chunks"] == 2
+    assert perf.main(["compare", str(out), str(out)]) == 0
+    assert perf.main(["report", str(out)]) == 0
+    # Subcommand dispatch through the umbrella CLI (jax-free for report).
+    from tpusim.cli import main as cli_main
+
+    assert cli_main(["perf", "report", str(out)]) == 0
+
+
+def test_committed_calibration_baseline_is_valid():
+    """The baseline ci.sh gates against must stay schema-valid and carry
+    both canonical scenarios at the quick shape."""
+    from pathlib import Path
+
+    path = Path(__file__).resolve().parent.parent / "artifacts" / "perf" / "calibration_cpu.jsonl"
+    rows = perf.load_rows(path)
+    latest = perf.latest_by_scenario(rows)
+    assert ("chained_fast", "s_per_chunk") in latest
+    assert ("chained_exact", "s_per_chunk") in latest
+    for row in latest.values():
+        assert row["env"]["platform"] == "cpu"
+        assert row["shape"]["runs"] == perf.PROTOCOL["quick"]["runs"]
+        assert len(row["samples"]) == perf.PROTOCOL["quick"]["repeats"]

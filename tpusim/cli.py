@@ -14,6 +14,8 @@ Examples:
     python -m tpusim watch artifacts/telemetry/run.jsonl
     python -m tpusim trace --runs 4 --days 2 --trace-out flight.trace.json
     python -m tpusim trace diff jax_events.jsonl native_events.jsonl
+    python -m tpusim perf run --quick
+    python -m tpusim perf compare artifacts/perf/calibration_cpu.jsonl new.jsonl
 
 The ``report`` subcommand (tpusim.report) renders a ``--telemetry`` JSONL
 ledger — or a ``--trace-dir`` XLA trace directory — into a dashboard; the
@@ -202,6 +204,13 @@ def main(argv: list[str] | None = None) -> int:
         from .flight_export import main as trace_main
 
         return trace_main(argv[1:])
+    if argv and argv[0] == "perf":
+        # Same dispatch rule. The module import is jax-free; only `perf run`
+        # initializes a backend — `perf compare` (the CI noise gate) and
+        # `perf report` must work on a host with none.
+        from .perf import main as perf_main
+
+        return perf_main(argv[1:])
     args = build_parser().parse_args(argv)
     try:
         config = config_from_args(args)

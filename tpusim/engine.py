@@ -860,6 +860,17 @@ class Engine:
         self.params = make_params(config)
         return self
 
+    def memory_attrs(self) -> dict[str, int]:
+        """Static memory model of this engine's compiled programs, merged
+        into every ``batch`` telemetry span: the dtype-resolved per-run
+        state footprint (the same number the roofline traffic model calls
+        ``state`` — packed int16 leaves halve it). :class:`PallasEngine`
+        extends this with its kernel VMEM estimate against the scoped-VMEM
+        budget, so the ledger shows headroom, not just usage."""
+        from .profiling import state_bytes_per_run
+
+        return {"state_bytes_per_run": int(state_bytes_per_run(self))}
+
     def make_keys(self, start: int, count: int) -> jax.Array:
         """The per-run sampling-identity array for global run indices
         [start, start+count) — threefry keys by default, packed xoroshiro

@@ -14,7 +14,10 @@ committed place instead of being hardcoded across rules:
   * ``device_call_patterns`` — method-name substrings whose call results are
     device values for the JX002 taint (the engine's jitted entry points);
   * ``prng_consumers`` — extra PRNG-consuming callables for JX004 beyond
-    ``jax.random.*`` (the xoroshiro sequential generator).
+    ``jax.random.*`` (the xoroshiro sequential generator);
+  * ``measurement_modules`` — benchmark/profiling code where an unblocked
+    clock delta around a device dispatch (JX009) measures launch overhead
+    instead of execution.
 
 TOML parsing uses the stdlib ``tomllib`` when present (3.11+) and falls back
 to ``tomli`` on 3.10; with neither available the committed defaults below
@@ -63,7 +66,13 @@ _DEFAULT_DEVICE_CALLS = (
     "run_batch_async",
 )
 _DEFAULT_PRNG_CONSUMERS = ("next_words",)
-_ALL_RULE_IDS = tuple(f"JX{n:03d}" for n in range(1, 9))
+_DEFAULT_MEASUREMENT = (
+    "bench.py",
+    "tpusim/profiling.py",
+    "tpusim/perf.py",
+    "scripts/*.py",
+)
+_ALL_RULE_IDS = tuple(f"JX{n:03d}" for n in range(1, 10))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -76,6 +85,7 @@ class LintConfig:
     unused_globs: tuple[str, ...] = _DEFAULT_UNUSED
     device_call_patterns: tuple[str, ...] = _DEFAULT_DEVICE_CALLS
     prng_consumers: tuple[str, ...] = _DEFAULT_PRNG_CONSUMERS
+    measurement_modules: tuple[str, ...] = _DEFAULT_MEASUREMENT
 
     def matches(self, rel_path: str, globs: tuple[str, ...]) -> bool:
         rel = rel_path.replace("\\", "/")
@@ -108,6 +118,7 @@ def load_config(pyproject: Path | None = None) -> LintConfig:
         ("unused_globs", "unused-globs"),
         ("device_call_patterns", "device-call-patterns"),
         ("prng_consumers", "prng-consumers"),
+        ("measurement_modules", "measurement-modules"),
     ):
         if key in block:
             kwargs[field] = tuple(str(v) for v in block[key])

@@ -436,6 +436,93 @@ def check_unused(ma: ModuleAnalysis) -> Iterator[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# JX009 — unblocked timing in measurement modules.
+
+#: Clock calls whose assigned-then-subtracted pattern marks a timed interval.
+_CLOCK_FUNCS = frozenset({"time.perf_counter", "time.monotonic"})
+
+
+def check_unblocked_timing(ma: ModuleAnalysis) -> Iterator[Finding]:
+    """A ``time.perf_counter()``/``time.monotonic()`` delta that brackets a
+    device dispatch with no ``block_until_ready`` between the dispatch and
+    the delta: JAX dispatch is asynchronous, so the interval measures launch
+    overhead, not execution — the classic timing bug (observed in this repo
+    as a 12-chunk program "running" in 46 us; see
+    profiling.time_chained_chunks). Dispatches are recognized by the same
+    ``device_call_patterns`` the JX002 taint seeds on — the calls whose
+    results are unforced device values. Only measurement modules are
+    scanned: in orchestration code an unforced interval is often the point
+    (pipelined stall accounting times exactly the non-blocking part)."""
+    if not ma.config.matches(ma.path, tuple(ma.config.measurement_modules)):
+        return
+    dispatch_pats = tuple(ma.config.device_call_patterns)
+    for func in [f.node for f in ma.funcs] + [ma.tree]:
+        own = list(ma.own_nodes(func))
+        marks: dict[str, list[int]] = {}
+        for node in own:
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if (dotted_name(node.value.func) or "") in _CLOCK_FUNCS:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            marks.setdefault(tgt.id, []).append(node.lineno)
+        if not marks:
+            continue
+        dispatches: list[tuple[int, str]] = []
+        syncs: list[int] = []
+        for node in own:
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Attribute):
+                # The attr directly, not via dotted_name: a sync often hangs
+                # off a call result (`fin().block_until_ready()`), whose
+                # base dotted_name cannot resolve.
+                leaf = node.func.attr
+            else:
+                leaf = (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+            if leaf == "block_until_ready":
+                syncs.append(node.lineno)
+            elif any(p in leaf for p in dispatch_pats):
+                dispatches.append((node.lineno, leaf))
+        if not dispatches:
+            continue
+        for node in own:
+            if not (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)):
+                continue
+            right = node.right
+            if not (isinstance(right, ast.Name) and right.id in marks):
+                continue
+            if isinstance(node.left, ast.Call):
+                left_is_clock = (dotted_name(node.left.func) or "") in _CLOCK_FUNCS
+            elif isinstance(node.left, ast.Name):
+                left_is_clock = node.left.id in marks
+            else:
+                left_is_clock = False
+            if not left_is_clock:
+                continue
+            starts = [ln for ln in marks[right.id] if ln <= node.lineno]
+            if not starts:
+                continue
+            t0_line = max(starts)  # the closest preceding re-mark wins
+            bracketed = [
+                (ln, leaf) for ln, leaf in dispatches if t0_line <= ln <= node.lineno
+            ]
+            if not bracketed:
+                continue
+            last_dispatch = max(ln for ln, _ in bracketed)
+            if any(last_dispatch <= s <= node.lineno for s in syncs):
+                continue
+            leaves = sorted({leaf for _, leaf in bracketed})
+            yield _finding(
+                ma, "JX009", node,
+                f"timed interval (lines {t0_line}-{node.lineno}) brackets "
+                f"device dispatch `{', '.join(leaves)}` with no "
+                f"block_until_ready before the delta — async dispatch "
+                f"returns immediately, so this measures launch overhead, "
+                f"not execution",
+            )
+
+
+# ---------------------------------------------------------------------------
 # Registry + entry points.
 
 RuleFn = Callable[[ModuleAnalysis], Iterator[Finding]]
@@ -449,6 +536,7 @@ ALL_RULES: dict[str, tuple[RuleFn, str]] = {
     "JX006": (check_recompile_risk, "jitted callable fed Python scalars inside loops"),
     "JX007": (check_nondeterministic_host, "time/random host calls in device-math modules"),
     "JX008": (check_unused, "unused module-level defs/imports (scripts)"),
+    "JX009": (check_unblocked_timing, "clock delta around a device dispatch with no block_until_ready"),
 }
 
 

@@ -61,12 +61,18 @@ from .state import (
     rebase,
 )
 
-__all__ = ["PallasEngine", "FAST_TILE_RUNS", "EXACT_TILE_RUNS"]
+__all__ = ["PallasEngine", "FAST_TILE_RUNS", "EXACT_TILE_RUNS", "VMEM_BUDGET"]
 
 #: Default run-tile widths (VPU lanes per grid cell), set from v5e
 #: measurements — see PallasEngine.__init__ for the rationale.
 FAST_TILE_RUNS = 512
 EXACT_TILE_RUNS = 256
+
+#: Scoped-VMEM budget the kernel's estimated footprint is guarded against
+#: (just under the 16 MiB scoped limit of the v5e generation the tile
+#: defaults were measured on). Also surfaced per batch in the telemetry
+#: ledger's memory attrs, so dashboards show headroom, not only usage.
+VMEM_BUDGET = 15_500_000
 
 logger = logging.getLogger("tpusim")
 
@@ -685,13 +691,16 @@ class PallasEngine(Engine):
         # select per recorded event — bulk, not contraction temporaries, so a
         # x2 allowance instead of the state's x10.
         vmem_est += config.flight_capacity * N_FIELDS * 4 * tile_runs * 2
-        if vmem_est > 15_500_000 and not interpret and vmem_guard:
+        if vmem_est > VMEM_BUDGET and not interpret and vmem_guard:
             raise ValueError(
                 f"estimated kernel VMEM footprint {vmem_est / 1e6:.1f} MB exceeds "
                 f"the 16 MB scoped limit ({m} miners, {'exact' if exact else 'fast'} "
                 f"mode, tile_runs={tile_runs}); use the scan engine"
             )
         super().__init__(config, mesh)
+        #: The guard's estimate, kept for the telemetry memory attrs
+        #: (memory_attrs): the per-batch ledger reports estimate vs. budget.
+        self.vmem_est = int(vmem_est)
         # The kernel consumes whole step blocks. The scan engine's auto
         # sizing is 64-aligned on every platform; silently changing an
         # explicitly requested chunk_steps would fork the sampling identity
@@ -778,6 +787,15 @@ class PallasEngine(Engine):
             # guard must not depend on that staying true.
             self._scan_fallback.rebind(twin_cfg, Engine(twin_cfg).reuse_key())
         return self
+
+    def memory_attrs(self) -> dict[str, int]:
+        """The scan model's per-run state footprint plus this kernel's
+        VMEM-residency estimate against the scoped budget — the number the
+        __init__ guard refuses on, now visible per batch in the ledger."""
+        attrs = super().memory_attrs()
+        attrs["vmem_est_bytes"] = self.vmem_est
+        attrs["vmem_budget_bytes"] = VMEM_BUDGET
+        return attrs
 
     def scan_twin(self) -> Engine:
         """A scan engine pinned to this engine's resolved chunk_steps — the

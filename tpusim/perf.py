@@ -1,0 +1,535 @@
+"""``tpusim perf`` — the performance regression ledger and its noise gate.
+
+The repo's perf evidence discipline — chained-chunk min-of-5 at pinned
+shapes, interleaved A/B runs, all samples recorded — lived in CHANGES.md
+prose and ad-hoc scripts, so only a human re-running the ritual could catch
+a regression. This module makes the ritual a command:
+
+  * ``perf run`` executes the canonical noise-disciplined protocol
+    (:func:`run_protocol`: chained-chunk timing of the fast and exact
+    headline configs at pinned shapes, min-of-repeats with EVERY sample
+    kept) and appends environment-fingerprinted rows to an append-only
+    ledger, ``artifacts/perf/perf_<platform>.jsonl`` by default;
+  * ``perf compare`` diffs the latest row per scenario of two ledgers with
+    a spread-aware noise model (:func:`compare_rows`) and exits nonzero
+    only on regressions beyond the measured noise — the CI gate
+    (scripts/ci.sh) runs it against a committed calibration baseline;
+  * ``perf report`` renders a ledger's trajectory per scenario, so "did
+    PR N make the kernel slower" is a table, not an archaeology dig
+    through CHANGES.md.
+
+Rows share one schema with ``bench.py``'s headline payloads (which append
+here too), so BENCH history and the kernel-timing ledger stop being two
+formats. Schema and gate are jax-free by construction — ``perf compare``,
+``perf report`` and the harvest validator must run on a host with no
+backend; only ``perf run`` imports jax (lazily).
+
+Noise model: each row keeps all its samples, so the gate derives the
+relative spread (max-min)/min of BOTH rows being compared and only flags a
+ratio beyond ``max(min_margin, noise_mult * spread)`` — a quiet pair of
+ledgers gets a tight gate, a noisy pair a loose one, and a synthetic 2x
+regression fails either way (pinned by tests/test_perf_obs.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import Any
+
+from .telemetry import environment_attrs
+
+__all__ = [
+    "SCHEMA",
+    "PROTOCOL",
+    "perf_row",
+    "validate_row",
+    "append_rows",
+    "load_rows",
+    "run_protocol",
+    "compare_rows",
+    "render_compare",
+    "render_report",
+    "default_ledger_path",
+    "main",
+]
+
+#: Ledger row schema version; bumped only on incompatible field changes.
+SCHEMA = 1
+
+#: Fields every ledger row must carry (validate_row). Anything else is an
+#: open extension namespace — rows are self-describing JSON, not a table.
+REQUIRED_FIELDS = ("schema", "scenario", "metric", "value", "unit", "better",
+                   "samples", "env")
+
+#: The canonical protocol shapes. "full" is the repo's evidence standard
+#: (chained-chunk min-of-5, 12x256 steps, 512 runs — every CHANGES.md perf
+#: claim since PR 4 used exactly this); "quick" is the CI calibration shape,
+#: small enough for every build but still chained (single-chunk timings are
+#: the ±40 % failure mode time_chained_chunks exists to kill).
+PROTOCOL: dict[str, dict[str, int]] = {
+    "full": {"runs": 512, "n_chunks": 12, "repeats": 5, "chunk_steps": 256},
+    "quick": {"runs": 128, "n_chunks": 4, "repeats": 3, "chunk_steps": 256},
+}
+
+def _git_rev() -> str | None:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=Path(__file__).resolve().parents[1],
+            capture_output=True, text=True, timeout=10,
+        )
+        return out.stdout.strip() or None
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def environment_fingerprint() -> dict[str, Any]:
+    """The row's environment identity: everything needed to judge whether
+    two rows are comparable at all (the ROADMAP's drift note — CPU numbers
+    from different hosts/jax versions are NOT comparable — as machine-read
+    fields instead of prose). Extends telemetry.environment_attrs with the
+    host and revision facts a benchmark row needs."""
+    env = dict(environment_attrs())
+    env["cpu_count"] = os.cpu_count()
+    env["date"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    rev = _git_rev()
+    if rev is not None:
+        env["git_rev"] = rev
+    return env
+
+
+def perf_row(
+    scenario: str,
+    metric: str,
+    value: float,
+    *,
+    unit: str,
+    samples: list[float] | None = None,
+    better: str = "lower",
+    shape: dict[str, Any] | None = None,
+    extra: dict[str, Any] | None = None,
+) -> dict[str, Any]:
+    """One validated ledger row. ``samples`` is the full measurement list
+    the headline ``value`` was reduced from (min for ``better="lower"``);
+    a single-measurement producer (bench.py's end-to-end headline) passes
+    ``[value]`` and the compare gate falls back to its margin floor."""
+    row: dict[str, Any] = {
+        "schema": SCHEMA,
+        "scenario": scenario,
+        "metric": metric,
+        "value": float(value),
+        "unit": unit,
+        "better": better,
+        "samples": [float(s) for s in (samples if samples is not None else [value])],
+        "env": environment_fingerprint(),
+    }
+    if shape:
+        row["shape"] = dict(shape)
+    if extra:
+        row.update(extra)
+    validate_row(row)
+    return row
+
+
+def validate_row(row: Any) -> None:
+    """Raise ValueError unless ``row`` is a structurally valid ledger row —
+    the schema gate behind append_rows, the harvest validator and the
+    compare loader (an append-only evidence file must never accumulate rows
+    nobody can compare against)."""
+    if not isinstance(row, dict):
+        raise ValueError(f"perf row must be an object, got {type(row).__name__}")
+    missing = [k for k in REQUIRED_FIELDS if k not in row]
+    if missing:
+        raise ValueError(f"perf row missing required field(s) {missing}: {row}")
+    if row["schema"] != SCHEMA:
+        raise ValueError(f"unknown perf row schema {row['schema']!r} (expected {SCHEMA})")
+    if row["better"] not in ("lower", "higher"):
+        raise ValueError(f"perf row 'better' must be lower|higher, got {row['better']!r}")
+    if not isinstance(row["value"], (int, float)) or isinstance(row["value"], bool):
+        raise ValueError(f"perf row value must be a number, got {row['value']!r}")
+    samples = row["samples"]
+    if (
+        not isinstance(samples, list)
+        or not samples
+        or not all(isinstance(s, (int, float)) and not isinstance(s, bool) for s in samples)
+    ):
+        raise ValueError(f"perf row samples must be a non-empty number list, got {samples!r}")
+    if not isinstance(row["env"], dict):
+        raise ValueError("perf row env must be an object")
+
+
+def append_rows(path: str | Path, rows: list[dict]) -> None:
+    """Validate and append rows to an append-only JSONL ledger."""
+    for row in rows:
+        validate_row(row)
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with path.open("a") as fh:
+        for row in rows:
+            fh.write(json.dumps(row) + "\n")
+
+
+def load_rows(path: str | Path) -> list[dict]:
+    """Read a ledger back, STRICT: a torn or foreign line in a perf ledger
+    is corrupted evidence, not tolerable noise — unlike telemetry spans
+    (load_spans), nothing writes here concurrently with a reader."""
+    rows = []
+    for i, line in enumerate(Path(path).read_text().splitlines(), start=1):
+        if not line.strip():
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"{path}:{i}: unparseable ledger line ({e})") from None
+        validate_row(row)
+        rows.append(row)
+    return rows
+
+
+def default_ledger_path(platform: str) -> Path:
+    return Path(__file__).resolve().parents[1] / "artifacts" / "perf" / f"perf_{platform}.jsonl"
+
+
+# ---------------------------------------------------------------------------
+# perf run — the canonical protocol.
+
+
+def run_protocol(
+    *,
+    quick: bool = False,
+    engine: str = "auto",
+    scenarios: tuple[str, ...] = ("fast", "exact"),
+    runs: int | None = None,
+    n_chunks: int | None = None,
+    repeats: int | None = None,
+    chunk_steps: int | None = None,
+) -> list[dict]:
+    """Execute the canonical chained-chunk protocol and return ledger rows
+    (one per scenario), every repeat sample recorded. The scenarios are the
+    two headline configs every CHANGES.md perf claim uses: ``fast`` (9-miner
+    2025 roster, 1 s propagation, honest) and ``exact`` (the reference's
+    40 % selfish gamma=0 benchmark)."""
+    from .config import (
+        DEFAULT_DURATION_MS,
+        SimConfig,
+        default_network,
+        reference_selfish_network,
+    )
+    from .profiling import time_chained_chunks
+    from .runner import make_engine
+
+    p = dict(PROTOCOL["quick" if quick else "full"])
+    for name, override in (("runs", runs), ("n_chunks", n_chunks),
+                           ("repeats", repeats), ("chunk_steps", chunk_steps)):
+        if override is not None:
+            p[name] = override
+
+    nets = {
+        "fast": lambda: default_network(propagation_ms=1000),
+        "exact": reference_selfish_network,
+    }
+    unknown = [s for s in scenarios if s not in nets]
+    if unknown:
+        raise ValueError(f"unknown scenario(s) {unknown}; known: {sorted(nets)}")
+
+    rows = []
+    for name in scenarios:
+        cfg = SimConfig(
+            network=nets[name](), duration_ms=DEFAULT_DURATION_MS,
+            runs=p["runs"], batch_size=p["runs"], seed=7,
+            chunk_steps=p["chunk_steps"],
+        )
+        if engine == "scan":
+            from .engine import Engine
+
+            eng = Engine(cfg)
+        elif engine == "pallas":
+            from .pallas_engine import PallasEngine
+
+            eng = PallasEngine(cfg)
+        else:
+            eng = make_engine(cfg)
+        timing = time_chained_chunks(
+            eng, eng.make_keys(0, p["runs"]), n_chunks=p["n_chunks"],
+            repeats=p["repeats"],
+        )
+        shape = {
+            "runs": timing["runs"],
+            "n_chunks": timing["n_chunks"],
+            "chunk_steps": timing["chunk_steps"],
+            "superstep": timing["superstep"],
+            "engine": timing["engine"],
+            "mode": cfg.resolved_mode,
+            "rng_batch": cfg.rng_batch,
+            "state_dtype": cfg.resolved_count_dtype,
+        }
+        rows.append(perf_row(
+            f"chained_{name}", "s_per_chunk", timing["s_per_chunk"],
+            unit="s/chunk", better="lower",
+            samples=[t / p["n_chunks"] for t in timing["repeats_s"]],
+            shape=shape,
+            extra={
+                "s_per_chunk_median": timing["s_per_chunk_median"],
+                "us_per_step": timing["us_per_step"],
+                "spread_pct": timing["spread_pct"],
+                "protocol": "quick" if quick else "full",
+            },
+        ))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# perf compare — the spread-aware noise gate.
+
+
+def _rel_spread(samples: list[float]) -> float:
+    lo = min(samples)
+    if lo <= 0:
+        return 0.0
+    return (max(samples) - lo) / lo
+
+
+def latest_by_scenario(rows: list[dict]) -> dict[tuple[str, str], dict]:
+    """The newest row per (scenario, metric) — the append-only ledger's
+    current state. File order IS time order (rows are appended)."""
+    out: dict[tuple[str, str], dict] = {}
+    for row in rows:
+        out[(row["scenario"], row["metric"])] = row
+    return out
+
+
+def compare_rows(
+    base_rows: list[dict],
+    new_rows: list[dict],
+    *,
+    min_margin: float = 0.25,
+    noise_mult: float = 2.0,
+) -> list[dict]:
+    """Compare the latest row per scenario of two ledgers. Returns one
+    result dict per scenario with a ``status`` of:
+
+      * ``ok`` / ``improved`` / ``regression`` — ratio vs. the noise margin
+        (``max(min_margin, noise_mult * measured rel spread)``; the spread
+        is the worse of the two rows' sample spreads);
+      * ``missing`` — the baseline has the scenario, the candidate does not
+        (a gate that passes on an empty candidate ledger is a dead gate);
+      * ``incomparable`` — shape or unit fingerprints differ (a category
+        error, not a measurement).
+
+    ``ratio`` is normalized so > 1 always means worse, whatever the row's
+    ``better`` direction.
+    """
+    base = latest_by_scenario(base_rows)
+    new = latest_by_scenario(new_rows)
+    results = []
+    for key in sorted(set(base) | set(new)):
+        scenario, metric = key
+        b, n = base.get(key), new.get(key)
+        res: dict[str, Any] = {"scenario": scenario, "metric": metric}
+        if b is None:
+            res.update(status="new", value=n["value"])
+            results.append(res)
+            continue
+        if n is None:
+            res.update(status="missing", base_value=b["value"])
+            results.append(res)
+            continue
+        # Whole-dict shape equality, deliberately strict: every key a
+        # producer pins (runs/chunks/engine/..., bench's batch_size and
+        # pipelined too) is part of comparability — comparing a 512-run
+        # timing against a 128-run one is a category error, not noise.
+        if b.get("shape") != n.get("shape") or b["unit"] != n["unit"] \
+                or b["better"] != n["better"]:
+            res.update(
+                status="incomparable",
+                base_shape=b.get("shape"), new_shape=n.get("shape"),
+            )
+            results.append(res)
+            continue
+        worse = (
+            n["value"] / b["value"] if b["better"] == "lower"
+            else b["value"] / n["value"]
+        ) if b["value"] > 0 and n["value"] > 0 else float("inf")
+        noise = max(_rel_spread(b["samples"]), _rel_spread(n["samples"]))
+        margin = max(min_margin, noise_mult * noise)
+        if worse > 1.0 + margin:
+            status = "regression"
+        elif worse < 1.0 - min(margin, 0.99):
+            status = "improved"
+        else:
+            status = "ok"
+        res.update(
+            status=status, base_value=b["value"], new_value=n["value"],
+            ratio=round(worse, 4), margin=round(margin, 4),
+            noise=round(noise, 4),
+        )
+        results.append(res)
+    return results
+
+
+def render_compare(results: list[dict]) -> str:
+    from .report import text_table
+
+    rows = []
+    for r in results:
+        detail = ""
+        if "ratio" in r:
+            detail = (f"{r['base_value']:g} -> {r['new_value']:g} "
+                      f"(x{r['ratio']:.3f}, margin {r['margin']:.0%})")
+        elif r["status"] == "missing":
+            detail = f"baseline {r['base_value']:g}, no candidate row"
+        elif r["status"] == "new":
+            detail = f"candidate {r['value']:g}, no baseline row"
+        elif r["status"] == "incomparable":
+            detail = "shape/unit fingerprints differ"
+        rows.append([r["scenario"], r["metric"], r["status"].upper(), detail])
+    lines = text_table(["scenario", "metric", "verdict", "detail"], rows)
+    return "\n".join(lines) + "\n"
+
+
+def render_report(rows: list[dict], scenario: str | None = None) -> str:
+    """The trajectory table: every row per scenario in ledger (= time)
+    order, environment columns inline so non-comparable rows are visibly
+    non-comparable."""
+    from .report import text_table
+
+    if scenario is not None:
+        rows = [r for r in rows if r["scenario"] == scenario]
+    if not rows:
+        return "perf ledger has no rows" + (f" for scenario {scenario!r}" if scenario else "") + "\n"
+    groups: dict[str, list[dict]] = {}
+    for row in rows:
+        groups.setdefault(row["scenario"], []).append(row)
+    out = []
+    for name in sorted(groups):
+        out.append(f"== {name} ==")
+        table_rows = []
+        for r in groups[name]:
+            env = r.get("env", {})
+            spread = _rel_spread(r["samples"]) if len(r["samples"]) > 1 else None
+            table_rows.append([
+                str(env.get("date", "?")),
+                str(env.get("git_rev", "?")),
+                str(env.get("platform", "?")),
+                str((r.get("shape") or {}).get("engine", "?")),
+                f"{r['value']:g} {r['unit']}",
+                f"{spread:.1%}" if spread is not None else "n/a",
+                str(len(r["samples"])),
+            ])
+        out.extend(text_table(
+            ["date", "rev", "platform", "engine", "value", "spread", "n"],
+            table_rows,
+        ))
+        out.append("")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# CLI.
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpusim perf",
+        description="Performance regression ledger: run the canonical "
+        "protocol, gate against a baseline, render the trajectory.",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p_run = sub.add_parser("run", help="execute the chained-chunk protocol, append ledger rows")
+    p_run.add_argument("--out", type=Path, help="ledger path (default artifacts/perf/perf_<platform>.jsonl)")
+    p_run.add_argument("--quick", action="store_true",
+                       help="CI calibration shape (128 runs, 4 chunks, "
+                            "min-of-3) instead of the full evidence shape "
+                            "(512 runs, 12 chunks, min-of-5)")
+    p_run.add_argument("--engine", choices=("auto", "scan", "pallas"), default="auto")
+    p_run.add_argument("--scenarios", default="fast,exact",
+                       help="comma-separated subset of fast,exact")
+    p_run.add_argument("--runs", type=int)
+    p_run.add_argument("--n-chunks", type=int)
+    p_run.add_argument("--repeats", type=int)
+    p_run.add_argument("--chunk-steps", type=int)
+
+    p_cmp = sub.add_parser("compare", help="noise-gated diff of two ledgers (exit 1 on regression)")
+    p_cmp.add_argument("base", type=Path)
+    p_cmp.add_argument("new", type=Path)
+    p_cmp.add_argument("--min-margin", type=float, default=0.25,
+                       help="regression threshold floor as a ratio fraction "
+                            "(default 0.25; raise on noisy shared hosts)")
+    p_cmp.add_argument("--noise-mult", type=float, default=2.0,
+                       help="margin = max(min-margin, noise-mult * measured "
+                            "relative sample spread)")
+
+    p_rep = sub.add_parser("report", help="render a ledger's trajectory")
+    p_rep.add_argument("path", type=Path)
+    p_rep.add_argument("--scenario")
+
+    args = ap.parse_args(argv)
+
+    if args.cmd == "run":
+        scenarios = tuple(s for s in args.scenarios.split(",") if s)
+        rows = run_protocol(
+            quick=args.quick, engine=args.engine, scenarios=scenarios,
+            runs=args.runs, n_chunks=args.n_chunks, repeats=args.repeats,
+            chunk_steps=args.chunk_steps,
+        )
+        if args.out is not None:
+            out = args.out
+        else:
+            import jax
+
+            out = default_ledger_path(jax.devices()[0].platform)
+        append_rows(out, rows)
+        for row in rows:
+            print(f"[perf] {row['scenario']}: {row['value']:g} {row['unit']} "
+                  f"(samples {row['samples']})")
+        print(f"[perf] appended {len(rows)} row(s) to {out}")
+        return 0
+
+    if args.cmd == "compare":
+        try:
+            base_rows = load_rows(args.base)
+            new_rows = load_rows(args.new)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        results = compare_rows(
+            base_rows, new_rows,
+            min_margin=args.min_margin, noise_mult=args.noise_mult,
+        )
+        print(render_compare(results), end="")
+        if any(r["status"] in ("missing", "incomparable") for r in results):
+            print("error: ledgers are not comparable (see verdicts above)",
+                  file=sys.stderr)
+            return 2
+        if not any(
+            r["status"] in ("ok", "improved", "regression") for r in results
+        ):
+            # An EMPTY (or disjoint) baseline marks every candidate row
+            # "new" and nothing is ever compared — a truncated calibration
+            # file must fail the gate loudly, not turn it green forever.
+            print("error: no comparable scenarios between the two ledgers "
+                  "(empty or truncated baseline?) — nothing was gated",
+                  file=sys.stderr)
+            return 2
+        if any(r["status"] == "regression" for r in results):
+            return 1
+        return 0
+
+    try:
+        rows = load_rows(args.path)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    print(render_report(rows, scenario=args.scenario), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

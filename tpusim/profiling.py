@@ -29,6 +29,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import json
+import statistics
 import time
 from typing import Any, Iterator
 
@@ -92,7 +93,10 @@ def time_chained_chunks(
     measured cost is the steady-state per-step cost of the engine's chunk
     program — pallas kernel or scan — independent of simulation duration.
     Returns the min-of-repeats timing (the standard noise-floor estimator)
-    plus the per-repeat list.
+    PLUS the full per-repeat sample list, the median and the spread — the
+    min is the headline, but a ledger row that only kept the best would be
+    unauditable (the perf regression gate's noise model derives from the
+    samples; tpusim.perf).
     """
     import jax
     import jax.numpy as jnp
@@ -134,6 +138,7 @@ def time_chained_chunks(
         prog(keys).block_until_ready()
         times.append(time.perf_counter() - t0)
     best = min(times)
+    median = statistics.median(times)
     steps = n_chunks * engine.chunk_steps
     # A sub-resolution fast path (e.g. a dead-code-eliminated program, or a
     # clock with coarse ticks) can return best == 0; the spread is undefined
@@ -146,7 +151,9 @@ def time_chained_chunks(
         "chunk_steps": engine.chunk_steps,
         "superstep": getattr(engine, "superstep", 1),
         "s_per_chunk": round(best / n_chunks, 6),
+        "s_per_chunk_median": round(median / n_chunks, 6),
         "us_per_step": round(best / steps * 1e6, 3),
+        "us_per_step_median": round(median / steps * 1e6, 3),
         "repeats_s": [round(t, 4) for t in times],
         "spread_pct": spread,
     }

@@ -8,7 +8,10 @@ Two input kinds, auto-detected:
     telemetry.throughput_report; single-batch ledgers render a flagged
     compile-contaminated estimate), a pipelined-dispatch stall histogram,
     the device-side simulation counters (max reorg depth, stale events,
-    active-step occupancy) aggregated over every batch span, and — when the
+    active-step occupancy) aggregated over every batch span, the
+    compile/engine-cache and device-memory panels (the ``compile`` /
+    ``engine_cache`` spans and per-batch memory watermarks of
+    tpusim.telemetry.CompileLedger / device_memory_attrs), and — when the
     ledger carries the runner's per-batch ``stats`` spans
     (tpusim.convergence) — the convergence panels: final CI half-widths per
     statistic, the ETA-to-target extrapolation, and the CI-narrowing
@@ -38,7 +41,7 @@ from typing import Any
 
 from .telemetry import BatchRecord, load_spans, throughput_report
 
-__all__ = ["render_report", "trace_attribution", "text_table", "main"]
+__all__ = ["render_report", "trace_attribution", "text_table", "format_bytes", "main"]
 
 
 # ---------------------------------------------------------------------------
@@ -51,6 +54,17 @@ _STALL_BUCKETS = (0.001, 0.01, 0.1, 1.0, 10.0)
 
 def _fmt_s(s: float) -> str:
     return f"{s * 1e3:.1f} ms" if s < 1.0 else f"{s:.2f} s"
+
+
+def format_bytes(n: int | float) -> str:
+    n = float(n)
+    if n >= 1e9:
+        return f"{n / 1e9:.2f} GB"
+    if n >= 1e6:
+        return f"{n / 1e6:.2f} MB"
+    if n >= 1e3:
+        return f"{n / 1e3:.1f} KB"
+    return f"{int(n)} B"
 
 
 def text_table(headers: list[str], rows: list[list[str]]) -> list[str]:
@@ -298,6 +312,81 @@ def render_report(spans: list[dict], fmt: str = "text") -> str:
                         for d, c in enumerate(rdh)
                     ],
                 )
+
+    compiles = [sp for sp in spans if sp["span"] == "compile"]
+    cache_sp = [sp for sp in spans if sp["span"] == "engine_cache"]
+    if compiles or cache_sp:
+        # Compile & engine-cache observability (tpusim.telemetry.CompileLedger):
+        # every XLA backend compile the run paid for, with the dispatch
+        # context the ledger narrated, plus the make_engine cache counters —
+        # a sweep whose grid points recompile shows up HERE, not only in a
+        # test someone remembers to run.
+        heading("XLA compiles & engine cache")
+        durs = [float(sp.get("dur_s", 0.0)) for sp in compiles]
+        rows = [
+            ["backend compiles", str(len(compiles))],
+            ["compile time (monitored events)", _fmt_s(sum(durs))],
+        ]
+        if durs:
+            rows.append(["slowest compile", _fmt_s(max(durs))])
+        if cache_sp:
+            hits = sum(
+                1 for sp in cache_sp if (sp.get("attrs") or {}).get("hit")
+            )
+            rows.append(
+                ["engine-cache lookups (hit / miss)",
+                 f"{hits} / {len(cache_sp) - hits}"]
+            )
+        table(["counter", "value"], rows)
+        by_ctx: dict[tuple[str, str], list[float]] = defaultdict(list)
+        for sp in compiles:
+            attrs = sp.get("attrs") or {}
+            by_ctx[
+                (str(attrs.get("engine", "?")),
+                 str(attrs.get("dispatch", "build")))
+            ].append(float(sp.get("dur_s", 0.0)))
+        if by_ctx:
+            table(
+                ["engine", "dispatch context", "compiles", "total"],
+                [
+                    [eng, ctx, str(len(ds)), _fmt_s(sum(ds))]
+                    for (eng, ctx), ds in sorted(
+                        by_ctx.items(), key=lambda kv: -sum(kv[1])
+                    )
+                ],
+            )
+
+    mem_attrs = [
+        sp.get("attrs") or {}
+        for sp in spans
+        if sp["span"] == "batch" and "mem_live_bytes" in (sp.get("attrs") or {})
+    ]
+    if mem_attrs:
+        # Per-batch memory watermarks (telemetry.device_memory_attrs + the
+        # engine's static footprint model): worst over the run.
+        heading("Device memory (batch watermarks)")
+        rows = [
+            ["live-buffer watermark (jax.live_arrays)",
+             format_bytes(max(a["mem_live_bytes"] for a in mem_attrs))],
+            ["live buffers (max)",
+             str(max(int(a.get("mem_live_buffers", 0)) for a in mem_attrs))],
+        ]
+        peaks = [a["mem_peak_bytes"] for a in mem_attrs if "mem_peak_bytes" in a]
+        if peaks:
+            rows.append(["allocator peak (memory_stats)", format_bytes(max(peaks))])
+        last = mem_attrs[-1]
+        if "state_bytes_per_run" in last:
+            rows.append(
+                ["state bytes per run (dtype-resolved)",
+                 format_bytes(last["state_bytes_per_run"])]
+            )
+        if "vmem_est_bytes" in last:
+            est, budget = last["vmem_est_bytes"], last.get("vmem_budget_bytes")
+            val = format_bytes(est)
+            if budget:
+                val += f" of {format_bytes(budget)} budget ({100 * est / budget:.0f}%)"
+            rows.append(["kernel VMEM estimate", val])
+        table(["counter", "value"], rows)
 
     sstats = [sp for sp in spans if sp["span"] == "stats"]
     if sstats:

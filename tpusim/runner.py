@@ -34,7 +34,7 @@ from .convergence import MomentAccumulator
 from .engine import Engine
 from .profiling import Profiler
 from .stats import SimResults
-from .telemetry import TelemetryRecorder
+from .telemetry import CompileLedger, TelemetryRecorder, device_memory_attrs
 
 logger = logging.getLogger("tpusim")
 
@@ -52,6 +52,7 @@ def make_engine(
     tile_runs: int | None = None,
     step_block: int | None = None,
     cache: dict | None = None,
+    compile_ledger=None,
 ):
     """Pick the fastest engine for the platform: the Pallas VMEM kernel
     (tpusim.pallas_engine) on TPU — fast mode for honest rosters, exact mode
@@ -76,7 +77,12 @@ def make_engine(
     costs a cheap ``rebind`` instead of a recompile. Construction is always
     performed (it is what resolves chunk_steps/superstep and validates the
     config); only the compiled-program cache is shared. Mesh-bound engines
-    participate too — the key carries the mesh's axis/device topology."""
+    participate too — the key carries the mesh's axis/device topology.
+
+    ``compile_ledger`` (tpusim.telemetry.CompileLedger) records each
+    ``cache`` lookup as an engine-cache hit/miss — the reuse counters the
+    perf-observability ledger pairs with the compile spans. Lookups with no
+    ``cache`` are not counted (there is no cache to hit)."""
     forced = prefer_pallas is True
     if prefer_pallas is None:
         prefer_pallas = (
@@ -95,6 +101,8 @@ def make_engine(
             return eng
         key = eng.reuse_key()
         cached = cache.get(key)
+        if compile_ledger is not None:
+            compile_ledger.cache_event(cached is not None, key)
         if cached is not None:
             return cached.rebind(config, key)
         cache[key] = eng
@@ -296,338 +304,370 @@ def run_simulation_config(
         # Both directions: the injector reports through the recorder, and
         # the recorder's own writes are a chaos seam (telemetry.write).
         telemetry.chaos = chaos
-    _sleep = sleeper if sleeper is not None else time.sleep
-    if mesh is None and use_all_devices and len(jax.devices()) > 1:
-        mesh = Mesh(np.array(jax.devices()), ("runs",))
+    # Compile observability rides with the span ledger: every XLA backend
+    # compile this run provokes lands as a `compile` span (duration, engine
+    # identity, dispatch context), and make_engine's cache lookups as
+    # engine_cache hit/miss spans. Host-side listener only — the compiled
+    # programs are untouched (pinned by tests/test_perf_obs.py).
+    compile_ledger = CompileLedger(telemetry).install() if telemetry is not None else None
+    try:
+        _sleep = sleeper if sleeper is not None else time.sleep
+        if mesh is None and use_all_devices and len(jax.devices()) > 1:
+            mesh = Mesh(np.array(jax.devices()), ("runs",))
 
-    n_dev = 1 if mesh is None else mesh.devices.size
-    batch = min(config.batch_size, config.runs)
-    batch -= batch % n_dev or 0
-    batch = max(batch, n_dev)
+        n_dev = 1 if mesh is None else mesh.devices.size
+        batch = min(config.batch_size, config.runs)
+        batch -= batch % n_dev or 0
+        batch = max(batch, n_dev)
 
-    prefer_pallas = None if engine == "auto" else (engine == "pallas")
-    eng = make_engine(
-        config, mesh, prefer_pallas=prefer_pallas,
-        tile_runs=tile_runs, step_block=step_block, cache=engine_cache,
-    )
-    # Always (re)assigned: a cache-shared engine may carry a previous run's
-    # injector, and this run's policy — chaos or none — must win.
-    eng.chaos = chaos
-    # A trailing remainder that doesn't fill the mesh runs on an unsharded
-    # single-device engine rather than silently changing the run count.
-    engine_unsharded: Engine | None = None
+        prefer_pallas = None if engine == "auto" else (engine == "pallas")
+        eng = make_engine(
+            config, mesh, prefer_pallas=prefer_pallas,
+            tile_runs=tile_runs, step_block=step_block, cache=engine_cache,
+            compile_ledger=compile_ledger,
+        )
+        # Always (re)assigned: a cache-shared engine may carry a previous run's
+        # injector, and this run's policy — chaos or none — must win.
+        eng.chaos = chaos
+        if compile_ledger is not None:
+            compile_ledger.set_context(
+                engine=type(eng).__name__, reuse_key=repr(eng.reuse_key())
+            )
+        # A trailing remainder that doesn't fill the mesh runs on an unsharded
+        # single-device engine rather than silently changing the run count.
+        engine_unsharded: Engine | None = None
 
-    # Everything that affects per-run sampling identity; `runs` and
-    # `batch_size` are excluded so a checkpointed sweep can be extended or
-    # re-batched without invalidating accumulated statistics.
-    fp_dict = json.loads(config.to_json())
-    fp_dict.pop("runs", None)
-    fp_dict.pop("batch_size", None)
-    # Flight recording is observational — it changes no draw and no statistic
-    # (pinned by tests/test_flight.py) — so it stays out of the fingerprint
-    # and pre-flight checkpoints keep resuming.
-    fp_dict.pop("flight_capacity", None)
-    # The superstep width K changes only how many events one device loop
-    # iteration unrolls — the per-event draw mapping (and therefore every
-    # statistic) is bit-identical across K — so it stays out of the
-    # fingerprint (which also keeps pre-superstep checkpoints resumable).
-    fp_dict.pop("superstep", None)
-    # Batched wide RNG and the packed-state dtype are pure compile-time
-    # knobs: the draws, their consumption order and every statistic are
-    # bit-identical either way (pinned by tests/test_rng_batch.py), so both
-    # stay out — checkpoints resume across rng_batch/state_dtype changes and
-    # across versions from before the knobs existed.
-    fp_dict.pop("rng_batch", None)
-    fp_dict.pop("state_dtype", None)
-    # The default generator is omitted so checkpoints from before the rng
-    # field existed (identical threefry draws) still resume; non-default
-    # generators fingerprint explicitly.
-    if fp_dict.get("rng") == "threefry":
-        fp_dict.pop("rng")
-    # mode="auto"'s routing rules may change between versions (e.g. the
-    # race-ratio threshold); fingerprint the *resolved* representation so a
-    # resumed sweep can never silently merge fast-mode (lower-bound stale)
-    # sums with exact-mode ones.
-    fp_dict["mode"] = config.resolved_mode
-    # Like mode: group_slots=None resolves by mode and the resolved buffer
-    # size affects overflow behavior, so it is part of the identity.
-    fp_dict["group_slots"] = config.resolved_group_slots
-    # chunk_steps=None resolves to an engine-chosen default that may change
-    # between versions; fingerprint the *resolved* value, which is what fixes
-    # the step->key sampling identity.
-    fp_dict["chunk_steps"] = eng.chunk_steps
-    fingerprint = json.dumps(fp_dict, sort_keys=True)
-    ckpt = (
-        _Checkpoint(Path(checkpoint_path), fingerprint, chaos=chaos)
-        if checkpoint_path else None
-    )
-    runs_done, sums = 0, None
-    if ckpt is not None:
-        t_ld = time.perf_counter()
-        loaded = ckpt.load()
-        if loaded is not None:
-            runs_done, sums = loaded
-            logger.info("resuming from checkpoint at %d/%d runs", runs_done, config.runs)
-            if telemetry is not None:
-                telemetry.emit(
-                    "checkpoint_load", dur_s=time.perf_counter() - t_ld,
-                    runs_done=runs_done, path=str(ckpt.path),
-                )
-
-    t0 = time.monotonic()
-    compile_s: float | None = None
-    last_done = t0
-    # Run-level totals of the per-batch device counters (engine.SimCounters
-    # reductions), reported on the closing "run" span and mirrored in every
-    # "batch" span's attrs.
-    tele_run = {"reorg_depth_max": 0, "stale_events": 0, "active_steps": 0,
-                "step_slots": 0, "retries": 0}
-    hist_run = {"stale_by_miner": None, "reorg_depth_hist": None}
-    # Streaming convergence state: exact moment fold + the post-compile run
-    # rate the ETA extrapolation divides by (batch 0 carries the jit compile,
-    # so it is excluded — the steady_is_first_batch discipline).
-    moments = MomentAccumulator()
-    steady_rate = {"runs": 0, "s": 0.0}
-
-    def finalize_with_retries(fin, this_engine, keys, start: int):
-        """Block on an async batch and apply the retry/fallback policy; a
-        failed async finalize re-runs the batch synchronously. Returns
-        (sums, attempts, engine) — the engine that actually produced the
-        result, so after a pallas->scan fallback the batch span attributes
-        the throughput to the engine that ran, not the one that failed."""
-        nonlocal eng
-        attempts = 0
-        while True:
-            try:
-                if chaos is not None:
-                    chaos.fire(
-                        "engine.dispatch", start=start, batch=start // batch,
-                        attempt=attempts, engine=type(this_engine).__name__,
-                    )
-                if fin is not None:
-                    out, fin = fin, None  # one shot: retries re-dispatch sync
-                    return out(), attempts, this_engine
-                return this_engine.run_batch(keys), attempts, this_engine
-            except Exception as e:  # noqa: BLE001 — batch-level retry is the point
-                if isinstance(e, ChaosPermanentError):
-                    # An injected permanent fault must fail fast on EVERY
-                    # engine: the pallas branch below exists for real Mosaic
-                    # lowering ValueErrors, and letting it absorb a drill's
-                    # permanent fault would report a recovery the guarantee
-                    # matrix forbids.
-                    raise
-                if not hasattr(this_engine, "scan_twin") \
-                        and isinstance(e, (ValueError, TypeError)):
-                    # Deterministic config errors (e.g. the int32 block-count
-                    # guard) are not transient: fail fast instead of retrying.
-                    # Only for non-Pallas engines — Mosaic lowering gaps often
-                    # surface as ValueError and must reach the scan_twin
-                    # fallback below (where a config error re-raises instantly:
-                    # run_batch validates before any device work).
-                    raise
-                if hasattr(this_engine, "scan_twin"):
-                    # Pallas kernel failed at compile/run time (e.g. a Mosaic
-                    # lowering gap on this TPU generation): permanently fall
-                    # back to the scan twin — same resolved chunk_steps, so
-                    # the sampling identity (and any checkpoint fingerprint)
-                    # is unchanged. Does not consume a retry attempt.
-                    logger.exception(
-                        "pallas engine failed at run %d; falling back to the scan engine",
-                        start,
-                    )
-                    if telemetry is not None:
-                        telemetry.emit("engine_fallback", start=start, error=repr(e)[:200])
-                    twin = this_engine.scan_twin()
-                    if this_engine is eng:
-                        eng = twin
-                    this_engine = twin
-                    continue
-                attempts += 1
-                exhausted = attempts > max_retries
-                # Bounded exponential backoff with deterministic jitter: an
-                # immediate retry hammers whatever infrastructure just failed
-                # (and a fleet of workers retrying in lockstep hammers it
-                # together). The jitter derives from (seed, start, attempt) —
-                # ints only, so hash() is unsalted — never from wall clock:
-                # drills reproduce exactly.
-                pause = 0.0
-                if not exhausted:
-                    rnd = random.Random(hash((config.seed, start, attempts)))
-                    pause = min(retry_backoff_s * 2 ** (attempts - 1), 30.0)
-                    pause *= 1.0 + 0.25 * rnd.random()
+        # Everything that affects per-run sampling identity; `runs` and
+        # `batch_size` are excluded so a checkpointed sweep can be extended or
+        # re-batched without invalidating accumulated statistics.
+        fp_dict = json.loads(config.to_json())
+        fp_dict.pop("runs", None)
+        fp_dict.pop("batch_size", None)
+        # Flight recording is observational — it changes no draw and no statistic
+        # (pinned by tests/test_flight.py) — so it stays out of the fingerprint
+        # and pre-flight checkpoints keep resuming.
+        fp_dict.pop("flight_capacity", None)
+        # The superstep width K changes only how many events one device loop
+        # iteration unrolls — the per-event draw mapping (and therefore every
+        # statistic) is bit-identical across K — so it stays out of the
+        # fingerprint (which also keeps pre-superstep checkpoints resumable).
+        fp_dict.pop("superstep", None)
+        # Batched wide RNG and the packed-state dtype are pure compile-time
+        # knobs: the draws, their consumption order and every statistic are
+        # bit-identical either way (pinned by tests/test_rng_batch.py), so both
+        # stay out — checkpoints resume across rng_batch/state_dtype changes and
+        # across versions from before the knobs existed.
+        fp_dict.pop("rng_batch", None)
+        fp_dict.pop("state_dtype", None)
+        # The default generator is omitted so checkpoints from before the rng
+        # field existed (identical threefry draws) still resume; non-default
+        # generators fingerprint explicitly.
+        if fp_dict.get("rng") == "threefry":
+            fp_dict.pop("rng")
+        # mode="auto"'s routing rules may change between versions (e.g. the
+        # race-ratio threshold); fingerprint the *resolved* representation so a
+        # resumed sweep can never silently merge fast-mode (lower-bound stale)
+        # sums with exact-mode ones.
+        fp_dict["mode"] = config.resolved_mode
+        # Like mode: group_slots=None resolves by mode and the resolved buffer
+        # size affects overflow behavior, so it is part of the identity.
+        fp_dict["group_slots"] = config.resolved_group_slots
+        # chunk_steps=None resolves to an engine-chosen default that may change
+        # between versions; fingerprint the *resolved* value, which is what fixes
+        # the step->key sampling identity.
+        fp_dict["chunk_steps"] = eng.chunk_steps
+        fingerprint = json.dumps(fp_dict, sort_keys=True)
+        ckpt = (
+            _Checkpoint(Path(checkpoint_path), fingerprint, chaos=chaos)
+            if checkpoint_path else None
+        )
+        runs_done, sums = 0, None
+        if ckpt is not None:
+            t_ld = time.perf_counter()
+            loaded = ckpt.load()
+            if loaded is not None:
+                runs_done, sums = loaded
+                logger.info("resuming from checkpoint at %d/%d runs", runs_done, config.runs)
                 if telemetry is not None:
                     telemetry.emit(
-                        "retry", start=start, attempt=attempts,
-                        error=repr(e)[:200], backoff_s=round(pause, 3),
-                    )
-                if exhausted:
-                    raise
-                logger.exception(
-                    "batch at run %d failed (attempt %d); retrying in %.2fs",
-                    start, attempts, pause,
-                )
-                if pause > 0:
-                    _sleep(pause)
-
-    # Depth-1 pipelined batch loop: batch b+1 is dispatched (run_batch_async)
-    # BEFORE batch b is finalized, so the host-side work of b — the transfer,
-    # the float64 reduction, checkpoint write, progress callback and b+1's
-    # key construction — overlaps b+1's device compute instead of
-    # serializing with it. Statistics are order-identical to the sequential
-    # loop: batches still accumulate in dispatch order.
-    dispatched = runs_done
-    pending = None  # (finalize, keys, this_batch, engine, start_index)
-    while runs_done < config.runs or pending is not None:
-        nxt = None
-        if dispatched < config.runs:
-            this_batch = min(batch, config.runs - dispatched)
-            if mesh is not None and this_batch % n_dev != 0:
-                if engine_unsharded is None:
-                    engine_unsharded = Engine(config, None)
-                    engine_unsharded.chaos = chaos
-                this_engine = engine_unsharded
-            else:
-                this_engine = eng
-            if mesh is not None and jax.process_count() > 1:
-                # Multi-controller: assemble the batch keys shard-by-shard so
-                # they can live on a mesh with non-addressable devices.
-                if config.rng != "threefry":
-                    raise NotImplementedError(
-                        "rng='xoroshiro' is a single-controller A/B mode; "
-                        "multi-process runs use the default threefry sampling"
-                    )
-                from .distributed import make_global_keys
-
-                keys = make_global_keys(config.seed, dispatched, this_batch, mesh)
-            else:
-                keys = this_engine.make_keys(dispatched, this_batch)
-            try:
-                if chaos is not None:
-                    chaos.fire("engine.dispatch_async", start=dispatched)
-                fin = this_engine.run_batch_async(keys)
-            except Exception:  # noqa: BLE001 — retried at finalize time
-                logger.exception(
-                    "async dispatch at run %d failed; will retry synchronously",
-                    dispatched,
-                )
-                fin = None
-            nxt = (fin, keys, this_batch, this_engine, dispatched)
-            dispatched += this_batch
-
-        if pending is not None:
-            fin, keys_p, nb, eng_p, start = pending
-            t_stall = time.perf_counter()
-            batch_sums, attempts, eng_p = finalize_with_retries(fin, eng_p, keys_p, start)
-            # Host time blocked waiting for the device: the pipelined-
-            # dispatch stall. Near-zero while the pipeline keeps the device
-            # ahead of the host; one batch duration when it does not.
-            stall_s = time.perf_counter() - t_stall
-            now = time.monotonic()
-            if profiler is not None:
-                # Completion-to-completion wall time: overlapped batches must
-                # not double-count the pipelined interval.
-                profiler.record(nb, now - last_done)
-            # The device-side counters ride the batch sums but aggregate by
-            # max/sum rather than into SimResults: strip them before the
-            # stat accumulation (checkpoint schema unchanged) and report
-            # them through the telemetry ledger instead.
-            tele_b = {k: batch_sums.pop(k) for k in list(batch_sums)
-                      if k.startswith("tele_")}
-            # Streaming-moment keys (tpusim.convergence): telemetry like the
-            # tele_ counters, stripped from the stat/checkpoint path (the
-            # checkpoint schema is unchanged; a resume restarts the
-            # accumulator) and folded into the run-scoped estimator.
-            stats_b = {k: batch_sums.pop(k) for k in list(batch_sums)
-                       if k.startswith("stats_")}
-            # Flight-recorder rows (if the config enabled recording) are
-            # event logs, not statistics: drop them from the sum/checkpoint
-            # path — `tpusim trace` is their collection pipeline.
-            for k in [k for k in batch_sums if k.startswith("flight_")]:
-                del batch_sums[k]
-            if stats_b:
-                moments.add(stats_b)
-            if tele_b:
-                step_slots = (
-                    int(tele_b["tele_chunks_max"]) * eng_p.chunk_steps * nb
-                )
-                tele_run["reorg_depth_max"] = max(
-                    tele_run["reorg_depth_max"], int(tele_b["tele_reorg_depth_max"])
-                )
-                tele_run["stale_events"] += int(tele_b["tele_stale_events_sum"])
-                tele_run["active_steps"] += int(tele_b["tele_active_steps_sum"])
-                tele_run["step_slots"] += step_slots
-                for name in hist_run:
-                    # tpusim-lint: disable=JX002 -- tele_b values are host
-                    # numpy already (run_batch reduces them before returning);
-                    # this is dtype bookkeeping, not a device fetch.
-                    v = np.asarray(tele_b[f"tele_{name}_sum"], dtype=np.int64)
-                    hist_run[name] = v if hist_run[name] is None else hist_run[name] + v
-            tele_run["retries"] += attempts
-            if telemetry is not None:
-                dur = now - last_done
-                attrs = dict(
-                    start=start, runs=nb, engine=type(eng_p).__name__,
-                    stall_s=round(stall_s, 6), retries=attempts,
-                )
-                if tele_b:
-                    attrs.update(
-                        reorg_depth_max=int(tele_b["tele_reorg_depth_max"]),
-                        stale_events=int(tele_b["tele_stale_events_sum"]),
-                        active_steps=int(tele_b["tele_active_steps_sum"]),
-                        chunks=int(tele_b["tele_chunks_max"]),
-                        step_slots=step_slots,
-                        stale_by_miner=tele_b["tele_stale_by_miner_sum"].tolist(),
-                        reorg_depth_hist=tele_b["tele_reorg_depth_hist_sum"].tolist(),
-                    )
-                telemetry.emit("batch", t_start=time.time() - dur, dur_s=dur, **attrs)
-            if compile_s is not None:
-                # Post-compile batches only: batch 0's wall time is jit
-                # compile + execution, and a rate fit through it would put
-                # the ETA off by the compile-to-compute ratio.
-                steady_rate["runs"] += nb
-                steady_rate["s"] += now - last_done
-            if telemetry is not None and stats_b:
-                rate_is_first_batch = steady_rate["s"] <= 0.0
-                rate = (
-                    steady_rate["runs"] / steady_rate["s"]
-                    if not rate_is_first_batch
-                    else nb / max(now - last_done, 1e-9)
-                )
-                telemetry.emit(
-                    # runs = the accumulator's session scope (what the CI
-                    # numbers derive from); runs_done = the run-level
-                    # cumulative INCLUDING a resumed checkpoint's base, so
-                    # progress displays stay truthful after a resume.
-                    "stats", runs=moments.n, runs_done=runs_done + nb,
-                    runs_total=config.runs,
-                    duration_ms=config.duration_ms,
-                    block_interval_s=config.network.block_interval_s,
-                    target_rel_hw=ci_target_rel,
-                    rate_runs_per_s=round(rate, 3),
-                    rate_is_first_batch=rate_is_first_batch,
-                    stats=moments.snapshot(
-                        target_rel_hw=ci_target_rel, rate_runs_per_s=rate
-                    ),
-                )
-            last_done = now
-            if compile_s is None:
-                compile_s = now - t0
-            if sums is None:
-                sums = _zero_sums(batch_sums)
-            for k in sums:
-                sums[k] = sums[k] + batch_sums[k]
-            runs_done += nb
-            if ckpt is not None:
-                t_ck = time.perf_counter()
-                ckpt.save(runs_done, sums)
-                if telemetry is not None:
-                    telemetry.emit(
-                        "checkpoint_save", dur_s=time.perf_counter() - t_ck,
+                        "checkpoint_load", dur_s=time.perf_counter() - t_ld,
                         runs_done=runs_done, path=str(ckpt.path),
                     )
-            if progress is not None:
-                progress(runs_done, config.runs)
-        pending = nxt
+
+        t0 = time.monotonic()
+        compile_s: float | None = None
+        last_done = t0
+        # Run-level totals of the per-batch device counters (engine.SimCounters
+        # reductions), reported on the closing "run" span and mirrored in every
+        # "batch" span's attrs.
+        tele_run = {"reorg_depth_max": 0, "stale_events": 0, "active_steps": 0,
+                    "step_slots": 0, "retries": 0}
+        hist_run = {"stale_by_miner": None, "reorg_depth_hist": None}
+        # Streaming convergence state: exact moment fold + the post-compile run
+        # rate the ETA extrapolation divides by (batch 0 carries the jit compile,
+        # so it is excluded — the steady_is_first_batch discipline).
+        moments = MomentAccumulator()
+        steady_rate = {"runs": 0, "s": 0.0}
+
+        def finalize_with_retries(fin, this_engine, keys, start: int):
+            """Block on an async batch and apply the retry/fallback policy; a
+            failed async finalize re-runs the batch synchronously. Returns
+            (sums, attempts, engine) — the engine that actually produced the
+            result, so after a pallas->scan fallback the batch span attributes
+            the throughput to the engine that ran, not the one that failed."""
+            nonlocal eng
+            attempts = 0
+            while True:
+                try:
+                    if chaos is not None:
+                        chaos.fire(
+                            "engine.dispatch", start=start, batch=start // batch,
+                            attempt=attempts, engine=type(this_engine).__name__,
+                        )
+                    if fin is not None:
+                        out, fin = fin, None  # one shot: retries re-dispatch sync
+                        return out(), attempts, this_engine
+                    return this_engine.run_batch(keys), attempts, this_engine
+                except Exception as e:  # noqa: BLE001 — batch-level retry is the point
+                    if isinstance(e, ChaosPermanentError):
+                        # An injected permanent fault must fail fast on EVERY
+                        # engine: the pallas branch below exists for real Mosaic
+                        # lowering ValueErrors, and letting it absorb a drill's
+                        # permanent fault would report a recovery the guarantee
+                        # matrix forbids.
+                        raise
+                    if not hasattr(this_engine, "scan_twin") \
+                            and isinstance(e, (ValueError, TypeError)):
+                        # Deterministic config errors (e.g. the int32 block-count
+                        # guard) are not transient: fail fast instead of retrying.
+                        # Only for non-Pallas engines — Mosaic lowering gaps often
+                        # surface as ValueError and must reach the scan_twin
+                        # fallback below (where a config error re-raises instantly:
+                        # run_batch validates before any device work).
+                        raise
+                    if hasattr(this_engine, "scan_twin"):
+                        # Pallas kernel failed at compile/run time (e.g. a Mosaic
+                        # lowering gap on this TPU generation): permanently fall
+                        # back to the scan twin — same resolved chunk_steps, so
+                        # the sampling identity (and any checkpoint fingerprint)
+                        # is unchanged. Does not consume a retry attempt.
+                        logger.exception(
+                            "pallas engine failed at run %d; falling back to the scan engine",
+                            start,
+                        )
+                        if telemetry is not None:
+                            telemetry.emit("engine_fallback", start=start, error=repr(e)[:200])
+                        twin = this_engine.scan_twin()
+                        if this_engine is eng:
+                            eng = twin
+                        this_engine = twin
+                        continue
+                    attempts += 1
+                    exhausted = attempts > max_retries
+                    # Bounded exponential backoff with deterministic jitter: an
+                    # immediate retry hammers whatever infrastructure just failed
+                    # (and a fleet of workers retrying in lockstep hammers it
+                    # together). The jitter derives from (seed, start, attempt) —
+                    # ints only, so hash() is unsalted — never from wall clock:
+                    # drills reproduce exactly.
+                    pause = 0.0
+                    if not exhausted:
+                        rnd = random.Random(hash((config.seed, start, attempts)))
+                        pause = min(retry_backoff_s * 2 ** (attempts - 1), 30.0)
+                        pause *= 1.0 + 0.25 * rnd.random()
+                    if telemetry is not None:
+                        telemetry.emit(
+                            "retry", start=start, attempt=attempts,
+                            error=repr(e)[:200], backoff_s=round(pause, 3),
+                        )
+                    if exhausted:
+                        raise
+                    logger.exception(
+                        "batch at run %d failed (attempt %d); retrying in %.2fs",
+                        start, attempts, pause,
+                    )
+                    if pause > 0:
+                        _sleep(pause)
+
+        # Depth-1 pipelined batch loop: batch b+1 is dispatched (run_batch_async)
+        # BEFORE batch b is finalized, so the host-side work of b — the transfer,
+        # the float64 reduction, checkpoint write, progress callback and b+1's
+        # key construction — overlaps b+1's device compute instead of
+        # serializing with it. Statistics are order-identical to the sequential
+        # loop: batches still accumulate in dispatch order.
+        dispatched = runs_done
+        pending = None  # (finalize, keys, this_batch, engine, start_index)
+        while runs_done < config.runs or pending is not None:
+            nxt = None
+            if dispatched < config.runs:
+                this_batch = min(batch, config.runs - dispatched)
+                if mesh is not None and this_batch % n_dev != 0:
+                    if engine_unsharded is None:
+                        engine_unsharded = Engine(config, None)
+                        engine_unsharded.chaos = chaos
+                    this_engine = engine_unsharded
+                else:
+                    this_engine = eng
+                if mesh is not None and jax.process_count() > 1:
+                    # Multi-controller: assemble the batch keys shard-by-shard so
+                    # they can live on a mesh with non-addressable devices.
+                    if config.rng != "threefry":
+                        raise NotImplementedError(
+                            "rng='xoroshiro' is a single-controller A/B mode; "
+                            "multi-process runs use the default threefry sampling"
+                        )
+                    from .distributed import make_global_keys
+
+                    keys = make_global_keys(config.seed, dispatched, this_batch, mesh)
+                else:
+                    keys = this_engine.make_keys(dispatched, this_batch)
+                if compile_ledger is not None:
+                    # Dispatch context for any compile this dispatch provokes
+                    # (cold program, remainder-batch engine, pallas fallback).
+                    compile_ledger.set_context(
+                        dispatch="run_batch_async", start=dispatched,
+                        engine=type(this_engine).__name__,
+                    )
+                try:
+                    if chaos is not None:
+                        chaos.fire("engine.dispatch_async", start=dispatched)
+                    fin = this_engine.run_batch_async(keys)
+                except Exception:  # noqa: BLE001 — retried at finalize time
+                    logger.exception(
+                        "async dispatch at run %d failed; will retry synchronously",
+                        dispatched,
+                    )
+                    fin = None
+                nxt = (fin, keys, this_batch, this_engine, dispatched)
+                dispatched += this_batch
+
+            if pending is not None:
+                fin, keys_p, nb, eng_p, start = pending
+                t_stall = time.perf_counter()
+                batch_sums, attempts, eng_p = finalize_with_retries(fin, eng_p, keys_p, start)
+                # Host time blocked waiting for the device: the pipelined-
+                # dispatch stall. Near-zero while the pipeline keeps the device
+                # ahead of the host; one batch duration when it does not.
+                stall_s = time.perf_counter() - t_stall
+                now = time.monotonic()
+                if profiler is not None:
+                    # Completion-to-completion wall time: overlapped batches must
+                    # not double-count the pipelined interval.
+                    profiler.record(nb, now - last_done)
+                # The device-side counters ride the batch sums but aggregate by
+                # max/sum rather than into SimResults: strip them before the
+                # stat accumulation (checkpoint schema unchanged) and report
+                # them through the telemetry ledger instead.
+                tele_b = {k: batch_sums.pop(k) for k in list(batch_sums)
+                          if k.startswith("tele_")}
+                # Streaming-moment keys (tpusim.convergence): telemetry like the
+                # tele_ counters, stripped from the stat/checkpoint path (the
+                # checkpoint schema is unchanged; a resume restarts the
+                # accumulator) and folded into the run-scoped estimator.
+                stats_b = {k: batch_sums.pop(k) for k in list(batch_sums)
+                           if k.startswith("stats_")}
+                # Flight-recorder rows (if the config enabled recording) are
+                # event logs, not statistics: drop them from the sum/checkpoint
+                # path — `tpusim trace` is their collection pipeline.
+                for k in [k for k in batch_sums if k.startswith("flight_")]:
+                    del batch_sums[k]
+                if stats_b:
+                    moments.add(stats_b)
+                if tele_b:
+                    step_slots = (
+                        int(tele_b["tele_chunks_max"]) * eng_p.chunk_steps * nb
+                    )
+                    tele_run["reorg_depth_max"] = max(
+                        tele_run["reorg_depth_max"], int(tele_b["tele_reorg_depth_max"])
+                    )
+                    tele_run["stale_events"] += int(tele_b["tele_stale_events_sum"])
+                    tele_run["active_steps"] += int(tele_b["tele_active_steps_sum"])
+                    tele_run["step_slots"] += step_slots
+                    for name in hist_run:
+                        # tpusim-lint: disable=JX002 -- tele_b values are host
+                        # numpy already (run_batch reduces them before returning);
+                        # this is dtype bookkeeping, not a device fetch.
+                        v = np.asarray(tele_b[f"tele_{name}_sum"], dtype=np.int64)
+                        hist_run[name] = v if hist_run[name] is None else hist_run[name] + v
+                tele_run["retries"] += attempts
+                if telemetry is not None:
+                    dur = now - last_done
+                    attrs = dict(
+                        start=start, runs=nb, engine=type(eng_p).__name__,
+                        stall_s=round(stall_s, 6), retries=attempts,
+                    )
+                    # Memory observability: the engine's static footprint model
+                    # (per-run state bytes; the pallas kernel adds its VMEM
+                    # estimate vs. budget) plus the backend's live-buffer
+                    # watermark — a host-side registry walk at batch
+                    # granularity, never a device sync.
+                    attrs.update(eng_p.memory_attrs())
+                    attrs.update(device_memory_attrs())
+                    if tele_b:
+                        attrs.update(
+                            reorg_depth_max=int(tele_b["tele_reorg_depth_max"]),
+                            stale_events=int(tele_b["tele_stale_events_sum"]),
+                            active_steps=int(tele_b["tele_active_steps_sum"]),
+                            chunks=int(tele_b["tele_chunks_max"]),
+                            step_slots=step_slots,
+                            stale_by_miner=tele_b["tele_stale_by_miner_sum"].tolist(),
+                            reorg_depth_hist=tele_b["tele_reorg_depth_hist_sum"].tolist(),
+                        )
+                    telemetry.emit("batch", t_start=time.time() - dur, dur_s=dur, **attrs)
+                if compile_s is not None:
+                    # Post-compile batches only: batch 0's wall time is jit
+                    # compile + execution, and a rate fit through it would put
+                    # the ETA off by the compile-to-compute ratio.
+                    steady_rate["runs"] += nb
+                    steady_rate["s"] += now - last_done
+                if telemetry is not None and stats_b:
+                    rate_is_first_batch = steady_rate["s"] <= 0.0
+                    rate = (
+                        steady_rate["runs"] / steady_rate["s"]
+                        if not rate_is_first_batch
+                        else nb / max(now - last_done, 1e-9)
+                    )
+                    telemetry.emit(
+                        # runs = the accumulator's session scope (what the CI
+                        # numbers derive from); runs_done = the run-level
+                        # cumulative INCLUDING a resumed checkpoint's base, so
+                        # progress displays stay truthful after a resume.
+                        "stats", runs=moments.n, runs_done=runs_done + nb,
+                        runs_total=config.runs,
+                        duration_ms=config.duration_ms,
+                        block_interval_s=config.network.block_interval_s,
+                        target_rel_hw=ci_target_rel,
+                        rate_runs_per_s=round(rate, 3),
+                        rate_is_first_batch=rate_is_first_batch,
+                        stats=moments.snapshot(
+                            target_rel_hw=ci_target_rel, rate_runs_per_s=rate
+                        ),
+                    )
+                last_done = now
+                if compile_s is None:
+                    compile_s = now - t0
+                if sums is None:
+                    sums = _zero_sums(batch_sums)
+                for k in sums:
+                    sums[k] = sums[k] + batch_sums[k]
+                runs_done += nb
+                if ckpt is not None:
+                    t_ck = time.perf_counter()
+                    ckpt.save(runs_done, sums)
+                    if telemetry is not None:
+                        telemetry.emit(
+                            "checkpoint_save", dur_s=time.perf_counter() - t_ck,
+                            runs_done=runs_done, path=str(ckpt.path),
+                        )
+                if progress is not None:
+                    progress(runs_done, config.runs)
+            pending = nxt
+    finally:
+        # The listener registration is process-global (no unregister in
+        # 0.4.x) — unsubscribe on EVERY exit so a failed run cannot leave
+        # a stale subscriber narrating a later run's ledger.
+        if compile_ledger is not None:
+            compile_ledger.uninstall()
 
     elapsed = time.monotonic() - t0
     assert sums is not None
@@ -639,13 +679,20 @@ def run_simulation_config(
             if tele_run["step_slots"] else None
         )
         hists = {k: v.tolist() for k, v in hist_run.items() if v is not None}
+        # Compile/cache totals from the session ledger: how many XLA
+        # compiles this run actually paid for, and how the engine cache
+        # spent vs. saved them — next to compile_s (batch-0 wall time),
+        # which also contains trace/lowering the monitoring events omit.
+        ledger_attrs = (
+            compile_ledger.summary_attrs() if compile_ledger is not None else {}
+        )
         telemetry.emit(
             "run", t_start=time.time() - elapsed, dur_s=elapsed,
             runs=runs_done, duration_ms=config.duration_ms,
             block_interval_s=config.network.block_interval_s,
             batch_size=batch, mode=config.resolved_mode,
             engine=type(eng).__name__, compile_s=round(compile_s or 0.0, 4),
-            occupancy=occupancy, **tele_run, **hists,
+            occupancy=occupancy, **tele_run, **hists, **ledger_attrs,
             # Environment identity: cross-host ledgers must be
             # self-describing (the ROADMAP's drift note, now machine-read).
             **environment_attrs(),

@@ -51,10 +51,12 @@ __all__ = [
     "TelemetryRecorder",
     "MetricsRegistry",
     "BatchRecord",
+    "CompileLedger",
     "throughput_report",
     "load_spans",
     "new_run_id",
     "environment_attrs",
+    "device_memory_attrs",
 ]
 
 
@@ -82,6 +84,116 @@ def environment_attrs() -> dict[str, Any]:
     except Exception:  # pragma: no cover - uninitializable backend
         pass
     return attrs
+
+
+def device_memory_attrs() -> dict[str, Any]:
+    """Per-batch device-memory observability, best effort and never raising
+    (same contract as :func:`environment_attrs`):
+
+      * ``mem_live_buffers`` / ``mem_live_bytes`` — count and byte total of
+        every live jax array in the process (``jax.live_arrays``), the
+        cross-platform live-buffer watermark;
+      * ``mem_bytes_in_use`` / ``mem_peak_bytes`` — the backend allocator's
+        own counters where the platform exposes ``memory_stats()`` (TPU/GPU;
+        the CPU backend reports none and the keys are simply absent).
+
+    Called once per batch span by the runner — a host-side walk of the live
+    array registry, nowhere near the dispatch hot path.
+    """
+    attrs: dict[str, Any] = {}
+    try:
+        import jax
+
+        live = jax.live_arrays()
+        attrs["mem_live_buffers"] = len(live)
+        attrs["mem_live_bytes"] = int(sum(getattr(a, "nbytes", 0) for a in live))
+        stats = jax.local_devices()[0].memory_stats()
+        if stats:
+            if "bytes_in_use" in stats:
+                attrs["mem_bytes_in_use"] = int(stats["bytes_in_use"])
+            if "peak_bytes_in_use" in stats:
+                attrs["mem_peak_bytes"] = int(stats["peak_bytes_in_use"])
+    except Exception:  # pragma: no cover - backend without the introspection
+        pass
+    return attrs
+
+
+class CompileLedger:
+    """Session-scoped XLA compile observability: one ``compile`` telemetry
+    span per backend compile, plus engine-cache hit/miss counters.
+
+    The assertion half of the compile story is
+    :func:`tpusim.testing.compile_count_guard` (tests pin "this block
+    compiles exactly N times"); this is the observability half — production
+    runs RECORD every compile with its duration and whatever context the
+    orchestration layer has set (which engine, which dispatch path, which
+    ``Engine.reuse_key``), so a recompile regression shows up in the ledger
+    of the run that paid for it instead of only in a test somebody runs.
+
+    Purely host-side by construction: it subscribes to the same
+    ``jax.monitoring`` duration-event listener the guard uses, so the chunk
+    programs are untouched (jaxpr byte-identical with a ledger armed —
+    pinned by tests/test_perf_obs.py). ``install``/``uninstall`` bound the
+    subscription to one run; the runner arms it whenever ``--telemetry`` is
+    on.
+    """
+
+    def __init__(self, recorder: "TelemetryRecorder | None" = None):
+        self.recorder = recorder
+        self.compiles = 0
+        self.compile_s = 0.0
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self._context: dict[str, Any] = {}
+        self._unsubscribe = None
+
+    def install(self) -> "CompileLedger":
+        if self._unsubscribe is None:
+            from .testing import subscribe_backend_compiles
+
+            self._unsubscribe = subscribe_backend_compiles(self._on_compile)
+        return self
+
+    def uninstall(self) -> None:
+        if self._unsubscribe is not None:
+            self._unsubscribe()
+            self._unsubscribe = None
+
+    def set_context(self, **attrs: Any) -> None:
+        """Merge orchestration context into every subsequent compile span —
+        the listener only sees (event name, duration), so the dispatch path
+        and engine identity must be narrated by whoever is dispatching."""
+        self._context.update(attrs)
+
+    def _on_compile(self, name: str, secs: float) -> None:
+        self.compiles += 1
+        self.compile_s += float(secs)
+        if self.recorder is not None:
+            self.recorder.emit(
+                "compile", t_start=time.time() - float(secs),
+                dur_s=float(secs), event=name, **self._context,
+            )
+
+    def cache_event(self, hit: bool, key: Any = None) -> None:
+        """One engine-cache lookup (tpusim.runner.make_engine): a hit rebinds
+        a warm compiled engine, a miss pays construction + first-dispatch
+        compilation. Emitted as an ``engine_cache`` span so sweeps show their
+        reuse discipline in the same ledger as the compiles it avoids."""
+        if hit:
+            self.cache_hits += 1
+        else:
+            self.cache_misses += 1
+        if self.recorder is not None:
+            self.recorder.emit("engine_cache", hit=bool(hit), key=repr(key))
+
+    def summary_attrs(self) -> dict[str, Any]:
+        """Run-level totals for the closing ``run`` span."""
+        return {
+            "compiles": self.compiles,
+            "compile_span_s": round(self.compile_s, 4),
+            "engine_cache_hits": self.cache_hits,
+            "engine_cache_misses": self.cache_misses,
+        }
 
 
 def new_run_id() -> str:

@@ -285,11 +285,33 @@ def compile_count_guard(*, exact: int | None = None, max_compiles: int | None = 
 
 
 _active_counters: list[CompileCount] = []
+_compile_subscribers: list = []
 _listener_installed = False
 
 
 def _is_backend_compile_event(name: str) -> bool:
     return "backend_compile" in name
+
+
+def subscribe_backend_compiles(fn):
+    """Register ``fn(event_name, secs)`` for every XLA backend compile this
+    process performs, on the SAME process-global listener the guard uses (one
+    registration, shared — 0.4.x has no unregister API, so every consumer
+    must ride one listener instead of stacking its own forever). Returns a
+    zero-argument unsubscribe callable. Subscriber exceptions are swallowed:
+    a telemetry sink must never be able to fail a compile.
+
+    This is the hook behind :class:`tpusim.telemetry.CompileLedger` — the
+    observability half of the compile story, where this guard is the
+    assertion half."""
+    _ensure_listener()
+    _compile_subscribers.append(fn)
+
+    def unsubscribe() -> None:
+        if fn in _compile_subscribers:
+            _compile_subscribers.remove(fn)
+
+    return unsubscribe
 
 
 def _ensure_listener() -> None:
@@ -304,6 +326,11 @@ def _ensure_listener() -> None:
         for counter in _active_counters:
             counter.count += 1
             counter.events.append(name)
+        for fn in list(_compile_subscribers):
+            try:
+                fn(name, secs)
+            except Exception:  # noqa: BLE001 — see subscribe_backend_compiles
+                pass
 
     jax.monitoring.register_event_duration_secs_listener(_on_duration)
     _listener_installed = True

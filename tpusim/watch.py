@@ -25,7 +25,7 @@ import time
 from pathlib import Path
 
 from .convergence import format_num, snapshot_rows
-from .report import text_table
+from .report import format_bytes, text_table
 from .telemetry import BatchRecord, load_spans, throughput_report
 
 __all__ = ["render_watch", "main"]
@@ -121,6 +121,34 @@ def render_watch(spans: list[dict], source: str, now: float | None = None) -> st
         retries = sum(int((sp.get("attrs") or {}).get("retries", 0)) for sp in batches)
         occ = f"{active / slots:.3f}" if slots else "n/a"
         out.append(f"occupancy {occ} · retries {retries}")
+
+    # --- Compile & memory (the perf-observability spans/attrs). Live view
+    # of what `tpusim report` renders as full panels: a recompiling sweep or
+    # a climbing live-buffer watermark should be visible while it happens.
+    compiles = [sp for sp in mine if sp["span"] == "compile"]
+    cache_sp = [sp for sp in mine if sp["span"] == "engine_cache"]
+    mem = [
+        sp.get("attrs") or {}
+        for sp in mine
+        if sp["span"] == "batch" and "mem_live_bytes" in (sp.get("attrs") or {})
+    ]
+    if compiles or cache_sp or mem:
+        parts = []
+        if compiles:
+            total = sum(float(sp.get("dur_s", 0.0)) for sp in compiles)
+            parts.append(f"compiles {len(compiles)} ({total:.2f} s)")
+        if cache_sp:
+            hits = sum(1 for sp in cache_sp if (sp.get("attrs") or {}).get("hit"))
+            parts.append(f"engine cache {hits}/{len(cache_sp)} hit")
+        if mem:
+            watermark = max(a["mem_live_bytes"] for a in mem)
+            parts.append(f"live buffers {format_bytes(watermark)}")
+            last = mem[-1]
+            if "vmem_est_bytes" in last and last.get("vmem_budget_bytes"):
+                parts.append(
+                    f"VMEM est {100 * last['vmem_est_bytes'] / last['vmem_budget_bytes']:.0f}% of budget"
+                )
+        out.append(" · ".join(parts))
 
     # --- Convergence (the stats spans this dashboard exists for).
     out.append("")
