@@ -135,6 +135,49 @@ env JAX_PLATFORMS=cpu python -m tpusim.cli perf compare \
   --min-margin 0.5
 python -m tpusim.cli perf report "$tele_dir/perf_quick.jsonl" > /dev/null
 
+echo "== fleet kill-drill smoke =="
+# The elastic-fleet healing contract end to end (tpusim.fleet): two
+# supervisor runs over the same 2-point grid — one clean, one with the
+# COMMITTED worker-kill drill plan (drills/fleet-worker-kill.json: SIGKILL
+# the attempt-0 worker right after its first checkpoint turns durable) —
+# must produce IDENTICAL rows minus wall-clock, the supervisor must requeue
+# exactly once and quarantine nothing, `tpusim watch` (started BEFORE the
+# ledger exists, via --wait-for-file) must follow the drill live and exit on
+# the closing span, and `tpusim report` must render the fleet panel.
+fleet_dir="$tele_dir/fleet"
+mkdir -p "$fleet_dir"
+timeout 420 python -m tpusim watch --no-clear --interval 1 \
+  --wait-for-file 300 "$fleet_dir/fleet.tele.jsonl" > "$fleet_dir/watch.txt" &
+watch_pid=$!
+env JAX_PLATFORMS=cpu python -m tpusim.cli fleet propagation --max-points 2 \
+  --runs-scale 3e-6 --batch-size 2 --workers 2 --single-device --no-probe \
+  --quiet --state-dir "$fleet_dir/ref" --lease-s 120
+env JAX_PLATFORMS=cpu python -m tpusim.cli fleet propagation --max-points 2 \
+  --runs-scale 3e-6 --batch-size 2 --workers 2 --single-device --no-probe \
+  --quiet --state-dir "$fleet_dir/drill" --lease-s 120 \
+  --telemetry "$fleet_dir/fleet.tele.jsonl" \
+  --worker-chaos drills/fleet-worker-kill.json --worker-chaos-point prop-100ms
+wait "$watch_pid"
+grep -q "fleet:" "$fleet_dir/watch.txt"
+env JAX_PLATFORMS=cpu python - "$fleet_dir/ref/rows.jsonl" \
+  "$fleet_dir/drill/rows.jsonl" "$fleet_dir/drill/fleet-ledger.jsonl" <<'EOF'
+import json, sys
+rows = []
+for path in sys.argv[1:3]:
+    parsed = [json.loads(ln) for ln in open(path) if ln.strip()]
+    for r in parsed:
+        r.pop("elapsed_s", None); r.pop("compile_s", None)
+    rows.append(parsed)
+ref, drill = rows
+assert [r["point"] for r in ref] == [r["point"] for r in drill], (ref, drill)
+assert ref == drill, "drilled fleet rows diverged from the uninterrupted run"
+events = [json.loads(ln)["event"] for ln in open(sys.argv[3]) if ln.strip()]
+assert events.count("requeue") == 1 and events.count("quarantine") == 0, events
+print(f"fleet kill drill: {len(drill)} rows bit-equal after 1 requeue")
+EOF
+env JAX_PLATFORMS=cpu python -m tpusim report "$fleet_dir/fleet.tele.jsonl" \
+  | grep -q "Fleet (worker supervisor)"
+
 echo "== flight-recorder trace smoke =="
 # One tiny flight-enabled run end-to-end: export the Perfetto trace + JSONL
 # event log, validate the trace schema, and cross-check the event rows

@@ -1,0 +1,67 @@
+"""Jax-free fake fleet worker (tests/test_fleet.py).
+
+Stands in for ``python -m tpusim.fleet --worker`` so the supervisor's queue /
+lease / requeue / quarantine / resume logic can be driven in milliseconds
+instead of seconds-per-jax-process. Behaviors (selected per point by the
+test's ``worker_cmd`` factory):
+
+  * ``ok``            — beat once, publish a row, exit 0
+  * ``fail``          — beat once, exit 1 (a crashing worker)
+  * ``hang``          — beat once, then freeze forever (a wedged worker: the
+                        supervisor's lease watchdog must SIGKILL it)
+  * ``fail-then-ok``  / ``hang-then-ok`` — misbehave on attempt 0 only, so
+                        the requeued attempt heals
+
+The published row records ``attempt`` and whether the worker-chaos env var
+was present, so tests can pin which attempt healed and that replacement
+workers run clean.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--point", required=True)
+    p.add_argument("--result", required=True)
+    p.add_argument("--heartbeat", required=True)
+    p.add_argument("--attempt", type=int, default=0)
+    p.add_argument("--behavior", default="ok")
+    p.add_argument("--runs", type=int, default=4)
+    args = p.parse_args()
+
+    with open(args.heartbeat, "a") as fh:
+        fh.write(json.dumps({
+            "t": time.time(), "beats": 0,
+            "runs_done": 0, "runs_total": args.runs,
+        }) + "\n")
+
+    behavior = args.behavior
+    if behavior == "fail-then-ok":
+        behavior = "fail" if args.attempt == 0 else "ok"
+    if behavior == "hang-then-ok":
+        behavior = "hang" if args.attempt == 0 else "ok"
+    if behavior == "fail":
+        return 1
+    if behavior == "hang":
+        while True:
+            time.sleep(60)
+
+    row = {
+        "runs": args.runs, "point": args.point, "backend": "tpu",
+        "elapsed_s": 0.01, "attempt": args.attempt,
+        "chaos_env": "TPUSIM_FLEET_WORKER_CHAOS" in os.environ,
+    }
+    tmp = args.result + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(row, fh)
+    os.replace(tmp, args.result)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -361,3 +361,81 @@ def test_checkpoint_resume_restarts_accumulator(tmp_path):
 
     assert "runs 8/8" in render_watch(spans, "x")
     assert res.runs == 8  # statistics still resumed
+
+
+# ---------------------------------------------------------------------------
+# The adaptive-precision DRIVER: ci_target_stat wires the per-batch CI to an
+# actual stop condition (run-until-confident), not just an ETA display.
+
+#: Shared compiled-engine cache across the driver tests (all SMALL-shaped).
+DRIVER_ENGINE_CACHE: dict = {}
+
+
+def test_ci_target_stop_by_target(tmp_path):
+    # A 1000% relative-half-width target is met by the very first batch
+    # (n=4 gives a variance estimate), so the 64-run budget stops at 4.
+    cfg = dataclasses.replace(SMALL, runs=64)
+    led, spans, res = _run_with_ledger(
+        tmp_path, cfg, engine_cache=DRIVER_ENGINE_CACHE,
+        ci_target_rel=10.0, ci_target_stat="blocks_share",
+    )
+    assert res.runs == 4  # statistics cover exactly the folded runs
+    run = next(sp for sp in spans if sp["span"] == "run")
+    assert run["attrs"]["stop_reason"] == "ci_target"
+    assert run["attrs"]["converged"] is True
+    assert run["attrs"]["ci_target_stat"] == "blocks_share"
+    assert run["attrs"]["runs"] == 4
+    # One stats span per EXECUTED batch; the abandoned in-flight batch left
+    # no trace.
+    assert sum(1 for sp in spans if sp["span"] == "stats") == 1
+
+
+def test_ci_target_stop_by_runs_exhausted(tmp_path):
+    led, spans, res = _run_with_ledger(
+        tmp_path, SMALL, engine_cache=DRIVER_ENGINE_CACHE,
+        ci_target_rel=1e-9, ci_target_stat="blocks_share",
+    )
+    assert res.runs == SMALL.runs  # budget exhausted without the target
+    run = next(sp for sp in spans if sp["span"] == "run")
+    assert run["attrs"]["stop_reason"] == "runs_exhausted"
+    assert run["attrs"]["converged"] is False
+
+
+def test_ci_target_stop_without_telemetry(tmp_path):
+    # The driver must not depend on a recorder being armed.
+    cfg = dataclasses.replace(SMALL, runs=64)
+    res = run_simulation_config(
+        cfg, use_all_devices=False, engine_cache=DRIVER_ENGINE_CACHE,
+        ci_target_rel=10.0, ci_target_stat="blocks_share",
+    )
+    assert res.runs == 4
+
+
+def test_ci_target_stat_validated(monkeypatch):
+    with pytest.raises(ValueError, match="unknown ci_target_stat"):
+        run_simulation_config(SMALL, ci_target_stat="nope")
+    with pytest.raises(ValueError, match="positive ci_target_rel"):
+        run_simulation_config(SMALL, ci_target_rel=0.0,
+                              ci_target_stat="blocks_share")
+    # Multi-controller meshes emit no moments, so the stop condition could
+    # never fire — must refuse loudly, not burn the budget silently.
+    import jax
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    with pytest.raises(ValueError, match="multi-controller"):
+        run_simulation_config(SMALL, ci_target_stat="blocks_share")
+    from tpusim.cli import main as cli_main
+
+    with pytest.raises(SystemExit, match="ci-target-stat"):
+        cli_main(["--backend", "cpp", "--ci-target-stat", "blocks_share"])
+
+
+def test_run_span_default_stop_reason(tmp_path):
+    # Without a target stat armed the closing span still narrates the stop:
+    # runs_exhausted, converged null (nothing was being targeted).
+    led, spans, res = _run_with_ledger(
+        tmp_path, SMALL, engine_cache=DRIVER_ENGINE_CACHE
+    )
+    run = next(sp for sp in spans if sp["span"] == "run")
+    assert run["attrs"]["stop_reason"] == "runs_exhausted"
+    assert run["attrs"]["converged"] is None

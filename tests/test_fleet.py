@@ -1,0 +1,497 @@
+"""Elastic sweep fleet (tpusim.fleet): the preemption-tolerant worker
+supervisor and its chaos drills.
+
+Two tiers, mirroring the module's design:
+
+  * **Supervisor logic** driven by a jax-free fake worker
+    (tests/fleet_fake_worker.py) — queue/lease/requeue/backoff/quarantine/
+    resume semantics in milliseconds per test;
+  * **End-to-end healing** driven by REAL ``run_simulation_config`` workers:
+    one fleet run whose attempt-0 workers are killed at every checkpoint
+    save phase, wedged past the lease deadline, and hit with ENOSPC — the
+    healed rows pinned BIT-EQUAL to an uninterrupted run at the same seed
+    (the tests/test_chaos.py contract, across process boundaries).
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+from types import SimpleNamespace
+
+import pytest
+
+from tpusim.chaos import ChaosInjector, ChaosPlan, FaultSpec, load_plan
+from tpusim.config import SimConfig, default_network
+from tpusim.fleet import WORKER_CHAOS_ENV, FleetSupervisor
+from tpusim.report import render_report
+from tpusim.runner import run_simulation_config
+from tpusim.telemetry import load_spans
+from tpusim.watch import main as watch_main
+from tpusim.watch import render_watch
+
+FAKE_WORKER = Path(__file__).with_name("fleet_fake_worker.py")
+
+#: Shared compiled-engine cache for the in-process reference runs.
+ENGINE_CACHE: dict = {}
+
+
+def fake_points(*names: str) -> list[tuple[str, SimConfig]]:
+    net = default_network(propagation_ms=1000)
+    return [(n, SimConfig(network=net, runs=4, batch_size=4)) for n in names]
+
+
+def fake_cmd(behaviors: dict[str, str] | None = None, log: list | None = None):
+    """A ``worker_cmd`` override launching the fake worker with a per-point
+    behavior; ``log`` records every (point, attempt) the supervisor spawned."""
+    behaviors = behaviors or {}
+
+    def cmd(asg: dict) -> list[str]:
+        if log is not None:
+            log.append((asg["point"], asg["attempt"]))
+        return [
+            sys.executable, str(FAKE_WORKER),
+            "--point", asg["point"],
+            "--result", str(asg["result_path"]),
+            "--heartbeat", str(asg["heartbeat_path"]),
+            "--attempt", str(asg["attempt"]),
+            "--behavior", behaviors.get(asg["point"], "ok"),
+        ]
+
+    return cmd
+
+
+def make_sup(tmp_path: Path, points, **kw) -> FleetSupervisor:
+    kw.setdefault("workers", 2)
+    kw.setdefault("backoff_s", 0.05)
+    kw.setdefault("poll_s", 0.05)
+    kw.setdefault("quiet", True)
+    kw.setdefault("state_dir", tmp_path / "fleet")
+    kw.setdefault("telemetry_path", tmp_path / "fleet" / "tele.jsonl")
+    return FleetSupervisor(points, **kw)
+
+
+def rows_of(sup: FleetSupervisor) -> list[dict]:
+    out = []
+    for line in sup.out_path.read_text().splitlines():
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    return out
+
+
+def events_of(sup: FleetSupervisor) -> list[dict]:
+    return [
+        json.loads(line)
+        for line in sup.ledger_path.read_text().splitlines()
+        if line.strip()
+    ]
+
+
+def plan(*faults: dict) -> ChaosPlan:
+    return ChaosPlan(faults=[FaultSpec(**f) for f in faults])
+
+
+# ---------------------------------------------------------------------------
+# Supervisor logic (fake workers).
+
+
+def test_fleet_completes_rows_in_point_order(tmp_path):
+    sup = make_sup(
+        tmp_path, fake_points("pt-a", "pt-b", "pt-c"),
+        worker_cmd=fake_cmd(),
+        worker_chaos={"pt-a": plan({"point": "never.fires"})},
+    )
+    summary = sup.run()
+    assert summary["points_done"] == 3
+    assert summary["requeues"] == 0 and summary["quarantined"] == []
+    rows = rows_of(sup)
+    # Out-of-order completions are buffered and flushed in POINT order, so
+    # the file is line-for-line comparable with run_sweep's.
+    assert [r["point"] for r in rows] == ["pt-a", "pt-b", "pt-c"]
+    # Worker-chaos plans ride the env into the matching point only.
+    assert [r["chaos_env"] for r in rows] == [True, False, False]
+    ev = [e["event"] for e in events_of(sup)]
+    assert ev[0] == "fleet_start" and ev[-1] == "fleet_finish"
+    assert ev.count("lease") == 3 and ev.count("done") == 3
+    spans = load_spans(sup.recorder.path)
+    assert {"fleet_spawn", "fleet_done", "fleet_status", "run"} <= {
+        s["span"] for s in spans
+    }
+    # The closing span is named "run" so `tpusim watch` exits on completion.
+    run = next(s for s in spans if s["span"] == "run")
+    assert run["attrs"]["fleet"] is True and run["attrs"]["points_done"] == 3
+
+
+def test_worker_crash_requeued_with_backoff_then_heals(tmp_path):
+    sup = make_sup(
+        tmp_path, fake_points("pt-a", "pt-b"),
+        worker_cmd=fake_cmd({"pt-b": "fail-then-ok"}),
+        worker_chaos={"pt-b": plan({"point": "never.fires"})},
+    )
+    summary = sup.run()
+    assert summary["points_done"] == 2 and summary["requeues"] == 1
+    rq = next(e for e in events_of(sup) if e["event"] == "requeue")
+    assert rq["point"] == "pt-b" and rq["reason"] == "exit:1"
+    assert rq["failures"] == 1 and rq["backoff_s"] > 0
+    healed = next(r for r in rows_of(sup) if r["point"] == "pt-b")
+    # The replacement worker is attempt 1 and runs WITHOUT the chaos env —
+    # a fresh process would re-arm every fault count and die forever.
+    assert healed["attempt"] == 1 and healed["chaos_env"] is False
+    spans = load_spans(sup.recorder.path)
+    rq_span = next(s for s in spans if s["span"] == "fleet_requeue")
+    assert rq_span["attrs"]["target"] == "pt-b"
+
+
+def test_poison_point_quarantined_loud_grid_drains(tmp_path, capsys):
+    sup = make_sup(
+        tmp_path, fake_points("pt-a", "pt-poison", "pt-c"),
+        worker_cmd=fake_cmd({"pt-poison": "fail"}),
+        max_point_failures=2,
+    )
+    summary = sup.run()
+    # Bounded: K consecutive deaths quarantine the point by NAME; the rest
+    # of the grid still drains and the summary is nonzero-worthy. The
+    # requeue counter matches the ledger's requeue EVENTS (the quarantined
+    # final death is not a requeue).
+    assert summary["quarantined"] == ["pt-poison"]
+    assert summary["points_done"] == 2 and summary["requeues"] == 1
+    assert "QUARANTINED point 'pt-poison'" in capsys.readouterr().err
+    assert [r["point"] for r in rows_of(sup)] == ["pt-a", "pt-c"]
+    q = next(e for e in events_of(sup) if e["event"] == "quarantine")
+    assert q["point"] == "pt-poison" and q["failures"] == 2
+    spans = load_spans(sup.recorder.path)
+    assert any(s["span"] == "fleet_quarantine" for s in spans)
+
+
+def test_lease_expiry_kills_hung_worker(tmp_path):
+    t0 = time.monotonic()
+    sup = make_sup(
+        tmp_path, fake_points("pt-hang"),
+        worker_cmd=fake_cmd({"pt-hang": "hang-then-ok"}),
+        lease_s=1.0,
+    )
+    summary = sup.run()
+    # The wall-clock watchdog: one beat, then silence past lease_s ->
+    # SIGKILL + requeue; the replacement attempt heals.
+    assert summary["points_done"] == 1 and summary["requeues"] == 1
+    rq = next(e for e in events_of(sup) if e["event"] == "requeue")
+    assert rq["reason"] == "lease_expired"
+    assert rows_of(sup)[0]["attempt"] == 1
+    assert time.monotonic() - t0 < 30.0
+
+
+def test_supervisor_heartbeat_hang_seam_expires_lease_in_chaos_time(tmp_path):
+    # The supervisor-side fleet.heartbeat drill: an injected hang makes the
+    # lease read as ALREADY expired, so the expiry path runs deterministically
+    # without waiting out a real 60 s lease.
+    t0 = time.monotonic()
+    sup = make_sup(
+        tmp_path, fake_points("pt-a"),
+        worker_cmd=fake_cmd({"pt-a": "hang-then-ok"}),
+        lease_s=60.0,
+        chaos=ChaosInjector(plan({
+            "point": "fleet.heartbeat", "kind": "hang", "count": -1,
+            "when": {"target": "pt-a", "attempt": 0},
+        })),
+    )
+    summary = sup.run()
+    assert summary["points_done"] == 1 and summary["requeues"] == 1
+    assert time.monotonic() - t0 < 30.0  # nowhere near the 60 s lease
+    rq = next(e for e in events_of(sup) if e["event"] == "requeue")
+    assert rq["reason"] == "lease_expired"
+    spans = load_spans(sup.recorder.path)
+    assert any(s["span"] == "chaos" for s in spans)  # the drill left its span
+
+
+def test_spawn_seam_transient_fault_requeued(tmp_path):
+    sup = make_sup(
+        tmp_path, fake_points("pt-a", "pt-b"),
+        worker_cmd=fake_cmd(),
+        chaos=ChaosInjector(plan({
+            "point": "fleet.spawn", "kind": "transient", "count": 1,
+            "when": {"target": "pt-a", "attempt": 0},
+        })),
+    )
+    summary = sup.run()
+    assert summary["points_done"] == 2 and summary["requeues"] == 1
+    rq = next(e for e in events_of(sup) if e["event"] == "requeue")
+    assert rq["point"] == "pt-a" and rq["reason"].startswith("spawn_failed")
+    assert [r["point"] for r in rows_of(sup)] == ["pt-a", "pt-b"]
+
+
+def test_supervisor_resume_adopts_orphaned_lease(tmp_path):
+    state = tmp_path / "fleet"
+    state.mkdir(parents=True)
+    # A previous supervisor's remains: pt-a's row landed, pt-b was leased
+    # when the supervisor died (no done event), pt-c never started.
+    (state / "rows.jsonl").write_text(json.dumps(
+        {"runs": 4, "point": "pt-a", "backend": "tpu", "elapsed_s": 1.0}
+    ) + "\n")
+    (state / "fleet-ledger.jsonl").write_text("\n".join([
+        json.dumps({"event": "fleet_start", "t": 0.0, "points": 3}),
+        json.dumps({"event": "lease", "t": 0.0, "point": "pt-a", "worker": "w000"}),
+        json.dumps({"event": "done", "t": 0.0, "point": "pt-a", "worker": "w000"}),
+        json.dumps({"event": "lease", "t": 0.0, "point": "pt-b", "worker": "w001",
+                    "pid": 99999}),
+    ]) + "\n")
+    spawned: list = []
+    sup = make_sup(
+        tmp_path, fake_points("pt-a", "pt-b", "pt-c"),
+        worker_cmd=fake_cmd(log=spawned), resume=True,
+    )
+    summary = sup.run()
+    # Only the orphaned and never-started points run; pt-a is skipped.
+    assert sorted(p for p, _ in spawned) == ["pt-b", "pt-c"]
+    assert summary["points_done"] == 3
+    ev = events_of(sup)
+    adopt = next(e for e in ev if e["event"] == "adopt")
+    assert adopt["point"] == "pt-b" and adopt["prior_worker"] == "w001"
+    rows = rows_of(sup)
+    assert [r["point"] for r in rows] == ["pt-a", "pt-b", "pt-c"]
+
+
+def test_supervisor_resume_reaps_live_orphan_worker(tmp_path):
+    state = tmp_path / "fleet"
+    state.mkdir(parents=True)
+    # A dead supervisor's worker that is STILL RUNNING (the fleet.spawn
+    # sigkill drill kills only the supervisor): its argv carries BOTH the
+    # fleet-worker marker and the point name, like a real worker's does —
+    # the reap guard requires both before it will SIGKILL a recorded pid.
+    orphan = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(300)",
+         "tpusim.fleet", "pt-b"]
+    )
+    try:
+        (state / "fleet-ledger.jsonl").write_text(json.dumps(
+            {"event": "lease", "t": 0.0, "point": "pt-b", "worker": "w009",
+             "pid": orphan.pid}
+        ) + "\n")
+        sup = make_sup(
+            tmp_path, fake_points("pt-b"), worker_cmd=fake_cmd(), resume=True,
+        )
+        summary = sup.run()
+        assert summary["points_done"] == 1
+        # The orphan was reaped BEFORE its replacement ran — no unsupervised
+        # process racing the new worker on the same checkpoint.
+        assert orphan.wait(timeout=10) == -signal.SIGKILL
+        adopt = next(e for e in events_of(sup) if e["event"] == "adopt")
+        assert adopt["reaped"] is True and adopt["prior_pid"] == orphan.pid
+    finally:
+        if orphan.poll() is None:
+            orphan.kill()
+
+
+def test_torn_ledger_and_out_lines_tolerated(tmp_path):
+    state = tmp_path / "fleet"
+    state.mkdir(parents=True)
+    # A killed supervisor can tear the final line of both files mid-write;
+    # resume must skip the fragments and the next append must repair the
+    # missing newline instead of gluing onto them.
+    (state / "rows.jsonl").write_text(
+        json.dumps({"runs": 4, "point": "pt-a", "backend": "tpu"})
+        + "\n" + '{"runs": 4, "point": "pt-'
+    )
+    (state / "fleet-ledger.jsonl").write_text(
+        json.dumps({"event": "lease", "t": 0.0, "point": "pt-b"})
+        + "\n" + '{"event": "don'
+    )
+    sup = make_sup(
+        tmp_path, fake_points("pt-a", "pt-b"),
+        worker_cmd=fake_cmd(), resume=True,
+    )
+    summary = sup.run()
+    assert summary["points_done"] == 2
+    raw = sup.out_path.read_text().splitlines()
+    parsed = rows_of(sup)
+    # Fragment line survives (newline-terminated, unparseable, skipped);
+    # pt-b's fresh row landed on its own line.
+    assert len(raw) == 3 and [r["point"] for r in parsed] == ["pt-a", "pt-b"]
+
+
+def test_duplicate_point_names_rejected(tmp_path):
+    with pytest.raises(ValueError, match="unique"):
+        make_sup(tmp_path, fake_points("pt-a", "pt-a"))
+
+
+# ---------------------------------------------------------------------------
+# The committed drill plans.
+
+
+def test_committed_drill_plans_load_and_name_known_seams():
+    drills = Path(__file__).parent.parent / "drills"
+    plans = sorted(drills.glob("*.json"))
+    assert len(plans) >= 5, plans
+    known = {
+        "engine.run_batch", "engine.dispatch", "engine.dispatch_async",
+        "pipeline.flag_fetch", "checkpoint.save", "checkpoint.load",
+        "telemetry.write", "probe.attempt", "sweep.point",
+        "fleet.spawn", "fleet.heartbeat",
+    }
+    for p in plans:
+        for fault in load_plan(p).faults:
+            assert fault.point in known, (p.name, fault.point)
+    names = {p.name for p in plans}
+    assert {"sigkill-pre-replace.json", "hang-fetch.json",
+            "enospc-telemetry.json", "fleet-worker-kill.json",
+            "fleet-worker-hang.json"} <= names
+
+
+# ---------------------------------------------------------------------------
+# `tpusim watch --wait-for-file` (the fleet-drill watcher satellite).
+
+
+def test_watch_wait_for_file_times_out_bounded(tmp_path, capsys):
+    t0 = time.monotonic()
+    rc = watch_main([
+        "--once", "--wait-for-file", "0.3", str(tmp_path / "never.jsonl")
+    ])
+    assert rc == 2
+    assert time.monotonic() - t0 < 5.0
+    assert "does not exist" in capsys.readouterr().err
+
+
+def test_watch_wait_for_file_picks_up_late_ledger(tmp_path, capsys):
+    led = tmp_path / "late.jsonl"
+
+    def writer():
+        time.sleep(0.4)
+        led.write_text(json.dumps({
+            "run_id": "abc", "span": "fleet_status", "t_start": time.time(),
+            "dur_s": 0.0, "attrs": {"workers_alive": 2, "points_done": 0,
+                                    "points_total": 3, "queued": 1},
+        }) + "\n")
+
+    th = threading.Thread(target=writer)
+    th.start()
+    rc = watch_main(["--once", "--wait-for-file", "10", str(led)])
+    th.join()
+    assert rc == 0
+    assert "fleet: 2 worker(s) alive" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# End-to-end healing with REAL workers: SIGKILL at every checkpoint save
+# phase, a full wedge past the lease deadline, and a checkpoint-write ENOSPC
+# — every point requeued exactly once, every healed row bit-equal.
+
+DRILL_CONFIG = SimConfig(
+    network=default_network(propagation_ms=1000),
+    duration_ms=10**8,
+    runs=8,
+    batch_size=4,
+    seed=3,
+)
+
+
+def _kill_at(phase: str) -> ChaosPlan:
+    return plan({"point": "checkpoint.save", "kind": "sigkill", "count": 1,
+                 "when": {"phase": phase}})
+
+
+DRILL_PLANS = {
+    "pt-kill-begin": _kill_at("begin"),
+    "pt-kill-pre": _kill_at("pre_replace"),
+    "pt-kill-post": _kill_at("post_replace"),
+    "pt-hang": plan({"point": "fleet.heartbeat", "kind": "hang", "count": 1,
+                     "when": {"beats": 1}}),
+    "pt-enospc": plan({"point": "checkpoint.save", "kind": "enospc",
+                       "count": 1, "when": {"phase": "begin"}}),
+}
+
+
+@pytest.fixture(scope="module")
+def drill(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("fleet_drill")
+    points = [(name, DRILL_CONFIG) for name in DRILL_PLANS]
+    sup = FleetSupervisor(
+        points,
+        workers=2,
+        state_dir=tmp / "fleet",
+        telemetry_path=tmp / "fleet" / "tele.jsonl",
+        worker_chaos=DRILL_PLANS,
+        single_device=True,
+        lease_s=10.0,
+        heartbeat_s=0.25,
+        backoff_s=0.05,
+        poll_s=0.1,
+        quiet=True,
+    )
+    summary = sup.run()
+    ref = run_simulation_config(
+        DRILL_CONFIG, use_all_devices=False, engine_cache=ENGINE_CACHE
+    )
+    return SimpleNamespace(
+        sup=sup, summary=summary,
+        ref_row={**ref.to_dict(), "backend": "tpu"},
+    )
+
+
+def test_drill_grid_heals_every_failure_mode(drill):
+    assert drill.summary["quarantined"] == []
+    assert drill.summary["points_done"] == len(DRILL_PLANS)
+    # Exactly one requeue per drilled point — and the documented reason each:
+    # a SIGKILLed/ENOSPC'd worker dies (nonzero/-9 exit), the wedged one is
+    # killed by the lease watchdog.
+    reasons = {
+        e["point"]: e["reason"]
+        for e in events_of(drill.sup) if e["event"] == "requeue"
+    }
+    assert reasons == {
+        "pt-kill-begin": "exit:-9",
+        "pt-kill-pre": "exit:-9",
+        "pt-kill-post": "exit:-9",
+        "pt-hang": "lease_expired",
+        "pt-enospc": "exit:1",
+    }
+
+
+def test_drill_rows_bit_equal_to_uninterrupted(drill):
+    rows = rows_of(drill.sup)
+    assert [r["point"] for r in rows] == list(DRILL_PLANS)
+    for row in rows:
+        got, want = dict(row), dict(drill.ref_row, point=row["point"])
+        for d in (got, want):  # wall-clock attrs differ; statistics must not
+            d.pop("elapsed_s", None)
+            d.pop("compile_s", None)
+        assert got == want, row["point"]
+
+
+def test_drill_healing_workers_resume_from_durable_checkpoints(drill):
+    # Which worker healed each point, from the done events.
+    healer = {
+        e["point"]: e["worker"]
+        for e in events_of(drill.sup) if e["event"] == "done"
+    }
+    workers_dir = drill.sup.state_dir / "workers"
+
+    def loads(point):
+        return load_spans(workers_dir / f"{healer[point]}.tele.jsonl")
+
+    # post_replace / the hang both died AFTER a durable 4-run checkpoint:
+    # the healing worker must RESUME it, not redo the point.
+    for point in ("pt-kill-post", "pt-hang"):
+        ld = [s for s in loads(point) if s["span"] == "checkpoint_load"]
+        assert len(ld) == 1 and ld[0]["attrs"]["runs_done"] == 4, point
+    # begin / pre_replace / enospc died with NO durable checkpoint: the
+    # healing worker restarts from zero (no checkpoint_load span)...
+    for point in ("pt-kill-begin", "pt-kill-pre", "pt-enospc"):
+        assert not any(s["span"] == "checkpoint_load" for s in loads(point)), point
+    # ...and pre_replace's stale tmp file was swept with the warning.
+    pre_log = (workers_dir / f"{healer['pt-kill-pre']}.log").read_text()
+    assert "removing stale checkpoint temp file" in pre_log
+
+
+def test_drill_dashboards_render_fleet_panels(drill):
+    spans = load_spans(drill.sup.recorder.path)
+    report = render_report(spans)
+    assert "Fleet (worker supervisor)" in report
+    assert "lease_expired" in report  # the requeue table names the reason
+    watch = render_watch(spans, "drill")
+    assert "fleet:" in watch and "5/5 points" in watch

@@ -50,6 +50,17 @@ Injection points wired through the repo (the plan's ``point`` vocabulary):
                         a dead tunnel probe, "transient" a failing one)
   sweep.point           run_sweep per grid point; target (the point name),
                         backend
+  fleet.spawn           FleetSupervisor before each worker spawn; target
+                        (point name), worker, attempt ("transient" = a spawn
+                        failure requeued with backoff, "sigkill" = the
+                        supervisor itself dies — the --resume drill)
+  fleet.heartbeat       two sides of the same liveness seam: the WORKER's
+                        progress callback (beats, runs_done — "hang" wedges
+                        the worker: heartbeats stop, compute freezes, the
+                        supervisor's lease watchdog must kill it) and the
+                        SUPERVISOR's per-poll heartbeat read (target, worker,
+                        attempt — "hang" makes the lease read as already
+                        expired, the deterministic-time expiry drill)
   ====================  =====================================================
 
 This module imports no jax (the probe must stay importable before any

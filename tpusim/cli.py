@@ -16,6 +16,8 @@ Examples:
     python -m tpusim trace diff jax_events.jsonl native_events.jsonl
     python -m tpusim perf run --quick
     python -m tpusim perf compare artifacts/perf/calibration_cpu.jsonl new.jsonl
+    python -m tpusim fleet propagation --workers 4 --state-dir fleet/
+    python -m tpusim fleet propagation --workers 4 --state-dir fleet/ --resume
 
 The ``report`` subcommand (tpusim.report) renders a ``--telemetry`` JSONL
 ledger — or a ``--trace-dir`` XLA trace directory — into a dashboard; the
@@ -119,8 +121,21 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--ci-target", type=float, default=0.01, metavar="REL_HW",
-        help="target relative 95%% CI half-width for the stats spans' "
-        "ETA extrapolation (default 0.01 = 1%%; needs --telemetry)",
+        help="target relative 95%% CI half-width: the ETA extrapolation in "
+        "the --telemetry stats spans, and the stop threshold when "
+        "--ci-target-stat arms run-until-confident (default 0.01 = 1%%)",
+    )
+    from .convergence import STATS
+
+    p.add_argument(
+        "--ci-target-stat", default=None, metavar="STAT",
+        # One source of truth with the runner's validation: the jax-free
+        # convergence statistic registry.
+        choices=tuple(s for s, _, _ in STATS),
+        help="run-until-confident: stop the batch loop once this statistic's "
+        "worst relative 95%% CI half-width (across miners) crosses "
+        "--ci-target — --runs then bounds the budget instead of fixing the "
+        "count; the closing run span records converged/stop_reason",
     )
     p.add_argument(
         "--chaos", type=Path, metavar="PLAN",
@@ -211,6 +226,13 @@ def main(argv: list[str] | None = None) -> int:
         from .perf import main as perf_main
 
         return perf_main(argv[1:])
+    if argv and argv[0] == "fleet":
+        # Same dispatch rule. The supervisor is jax-free by design — only
+        # its subprocess workers initialize a backend, so a wedged device
+        # can never take the supervisor down with it (tpusim.fleet).
+        from .fleet import main as fleet_main
+
+        return fleet_main(argv[1:])
     args = build_parser().parse_args(argv)
     try:
         config = config_from_args(args)
@@ -238,6 +260,11 @@ def main(argv: list[str] | None = None) -> int:
             raise SystemExit(
                 "error: --chaos injects faults at the tpu backend's "
                 "orchestration seams; the cpp backend has none"
+            )
+        if args.ci_target_stat:
+            raise SystemExit(
+                "error: --ci-target-stat drives the tpu backend's batch "
+                "loop; the cpp backend runs to completion in one call"
             )
         if args.tile_runs is not None or args.step_block is not None:
             raise SystemExit(
@@ -302,6 +329,7 @@ def main(argv: list[str] | None = None) -> int:
                     step_block=args.step_block,
                     chaos=chaos,
                     ci_target_rel=args.ci_target,
+                    ci_target_stat=args.ci_target_stat,
                 )
         finally:
             if recorder is not None:

@@ -1,0 +1,981 @@
+"""``tpusim fleet`` — preemption-tolerant elastic sweep supervisor.
+
+``run_sweep`` is one fragile process: any worker-level death — a preempted
+TPU VM, an OOM-killed process, a tunnel wedged inside C land — kills the
+whole grid, and the chaos harness (tpusim.chaos) can only drill faults
+*inside* that process. This module is the orchestration half of the
+ROADMAP's multi-host fleet item, built to be drillable entirely on CPU: a
+**jax-free supervisor** that dispatches sweep points to N subprocess workers
+(each worker = one ``run_simulation_config`` process with its own
+fingerprinted per-point checkpoint) and keeps the queue draining when
+workers die.
+
+Robustness discipline, layer by layer:
+
+  * **Leases + heartbeats + wall-clock watchdog.** Each leased point is
+    owned by one worker whose liveness is a heartbeat file (a daemon thread
+    in the worker beats every ``heartbeat_s`` even while the main thread is
+    blocked in a compile or a device dispatch). The supervisor arms a
+    per-worker wall-clock deadline — ``lease_s`` since the last observed
+    beat — which is ``chaos.fetch_with_deadline``'s discipline generalized
+    from one blocking fetch to whole-process liveness: a worker that outlives
+    its lease is SIGKILLed and its point requeued.
+  * **Requeue with bounded backoff, bit-equal healing.** A worker that dies
+    (SIGKILL/preemption), hangs past its deadline, or exits nonzero gets its
+    point requeued with bounded exponential backoff (base doubling, capped,
+    deterministic jitter from crc32 so drills reproduce); the replacement
+    worker resumes from the dead worker's durable checkpoint, and healed
+    rows are **bit-equal** to an uninterrupted sweep (the tests/test_chaos.py
+    contract, extended across process boundaries — pinned by
+    tests/test_fleet.py).
+  * **Poison-point quarantine.** A point that kills ``max_point_failures``
+    consecutive workers is quarantined LOUD with its name — the grid keeps
+    draining the other points and the supervisor exits nonzero, never an
+    infinite crash loop.
+  * **Crash-tolerant supervisor.** The work log is an append-only JSONL
+    ledger written with the same torn-line repair as sweep resume
+    (telemetry.append_jsonl_line) and read back tolerantly; ``--resume``
+    re-adopts orphaned leases (a lease with no matching done event) and
+    skips points whose rows already landed — so the supervisor itself can be
+    killed and restarted like any of its workers.
+  * **Deterministic drills.** The supervisor has its own chaos seams
+    (``fleet.spawn``, ``fleet.heartbeat``), and per-point chaos plans are
+    injected into workers via the environment (:data:`WORKER_CHAOS_ENV`,
+    armed for attempt 0 only — a replacement worker must run clean, the
+    same re-arm rule as sweep ``--resume``), so every failure mode above is
+    a deterministic drill: see ``drills/``.
+
+Output rows keep ``run_sweep``'s exact schema and point order (out-of-order
+completions are buffered and flushed in point order), so a fleet output
+diffs clean against a single-process sweep. Only the NamedSharding SPMD
+dispatch of the fleet item rides the next TPU window; everything here runs
+today.
+
+    python -m tpusim fleet propagation --workers 4 --state-dir fleet/ \\
+        --telemetry fleet/fleet.tele.jsonl
+    python -m tpusim fleet propagation --workers 4 --state-dir fleet/ --resume
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import zlib
+from pathlib import Path
+from typing import Any, Callable, Iterable
+
+from .chaos import ChaosError, ChaosInjector, ChaosPlan, InjectedHang, as_injector
+from .config import SimConfig
+from .telemetry import TelemetryRecorder, append_jsonl_line
+
+logger = logging.getLogger("tpusim")
+
+__all__ = [
+    "WORKER_CHAOS_ENV",
+    "FleetSupervisor",
+    "summarize_fleet_spans",
+    "worker_main",
+    "main",
+]
+
+
+def summarize_fleet_spans(spans: list[dict]) -> dict[str, Any] | None:
+    """Digest a telemetry ledger's ``fleet_*`` spans into the one summary
+    dict both dashboards render — THE shared extraction behind the
+    ``tpusim report`` fleet panel and ``tpusim watch``'s fleet line, so the
+    two surfaces cannot drift apart on the span schema. Returns None when
+    the ledger has no fleet spans; tolerates foreign/partial attrs (missing
+    keys, non-list leases) like every other ledger consumer."""
+    fleet_sp = [sp for sp in spans if str(sp.get("span", "")).startswith("fleet_")]
+    if not fleet_sp:
+        return None
+    by: dict[str, list[dict]] = {}
+    for sp in fleet_sp:
+        by.setdefault(sp["span"], []).append(sp)
+    status = (by["fleet_status"][-1].get("attrs") or {}) if by.get("fleet_status") else {}
+    quarantined = status.get("quarantined")
+    if not isinstance(quarantined, list):
+        quarantined = [
+            (sp.get("attrs") or {}).get("target", "?")
+            for sp in by.get("fleet_quarantine", ())
+        ]
+    leases = status.get("leases")
+    leases = (
+        [entry for entry in leases if isinstance(entry, dict)]
+        if isinstance(leases, list) else []
+    )
+    dones = len(by.get("fleet_done", ()))
+    return {
+        "status": status,
+        "spawns": len(by.get("fleet_spawn", ())),
+        "adopts": len(by.get("fleet_adopt", ())),
+        "points_done": status.get("points_done", dones),
+        "points_total": status.get("points_total"),
+        "workers_alive": status.get("workers_alive"),
+        "queued": status.get("queued"),
+        "requeues": [sp.get("attrs") or {} for sp in by.get("fleet_requeue", ())],
+        "quarantined": [str(q) for q in quarantined],
+        "leases": leases,
+    }
+
+#: Environment variable through which the supervisor injects a chaos plan
+#: (JSON text, not a path — self-contained across hosts) into one worker.
+WORKER_CHAOS_ENV = "TPUSIM_FLEET_WORKER_CHAOS"
+
+
+# ---------------------------------------------------------------------------
+# Worker side.
+
+
+class _Heartbeat:
+    """The worker's liveness signal: a daemon thread appending one JSON line
+    ``{"t", "beats", "runs_done", "runs_total"}`` to the heartbeat file every
+    ``interval_s`` — even while the main thread is blocked inside a compile
+    or a wedged device dispatch, which is exactly when a progress-callback
+    heartbeat would go silent and get a healthy worker killed.
+
+    ``progress`` doubles as the worker-side ``fleet.heartbeat`` chaos seam:
+    a ``hang`` fault wedges the worker COMPLETELY (beats stop and the run
+    freezes), simulating the preempted-VM/wedged-tunnel failure the
+    supervisor's lease watchdog exists for."""
+
+    def __init__(self, path: str | Path, interval_s: float, chaos=None):
+        self.path = Path(path)
+        self.interval_s = interval_s
+        self.chaos = chaos
+        self._state = {"runs_done": 0, "runs_total": None}
+        self._beats = 0
+        self._progress_calls = 0
+        self._stop = threading.Event()
+        self._wedged = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="tpusim-fleet-heartbeat"
+        )
+
+    def start(self) -> None:
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._write()
+        self._thread.start()
+
+    def _write(self) -> None:
+        row = {"t": round(time.time(), 3), "beats": self._beats, **self._state}
+        with self.path.open("a") as fh:
+            fh.write(json.dumps(row) + "\n")
+        self._beats += 1
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            if self._wedged.is_set():
+                return
+            try:
+                self._write()
+            except OSError:
+                # A transient beat-write failure (brief ENOSPC, an NFS
+                # stall) must not permanently silence a healthy worker:
+                # retry next interval. Only an outage that outlasts the
+                # lease becomes a watchdog kill + requeue — the
+                # supervisor's recovery path, never a worker crash.
+                continue
+
+    def progress(self, done: int, total: int) -> None:
+        """The runner's per-batch progress callback; also the worker-side
+        ``fleet.heartbeat`` chaos seam (context: beats = callback ordinal
+        starting at 1, runs_done)."""
+        self._state.update(runs_done=int(done), runs_total=int(total))
+        self._progress_calls += 1
+        if self.chaos is not None:
+            try:
+                self.chaos.fire(
+                    "fleet.heartbeat",
+                    beats=self._progress_calls, runs_done=int(done),
+                )
+            except InjectedHang:
+                # Simulate a full wedge: stop the beat thread, then freeze
+                # this (main) thread forever. Only SIGKILL from the
+                # supervisor's watchdog ends this process — by design.
+                self._wedged.set()
+                while True:
+                    time.sleep(3600)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def worker_main(argv: list[str] | None = None) -> int:
+    """One fleet worker: run one sweep point via ``run_simulation_config``
+    with a per-point checkpoint, beating the heartbeat file throughout, and
+    atomically publish the ``run_sweep``-schema result row. Spawned by the
+    supervisor as ``python -m tpusim.fleet --worker ...``; a chaos plan in
+    :data:`WORKER_CHAOS_ENV` is armed across every runner seam (that is how
+    the kill/hang/ENOSPC drills reach the worker)."""
+    p = argparse.ArgumentParser(prog="tpusim fleet --worker")
+    p.add_argument("--point", required=True)
+    p.add_argument("--config", required=True, type=Path)
+    p.add_argument("--result", required=True, type=Path)
+    p.add_argument("--heartbeat", required=True, type=Path)
+    p.add_argument("--checkpoint", required=True, type=Path)
+    p.add_argument("--heartbeat-s", type=float, default=1.0)
+    p.add_argument("--single-device", action="store_true")
+    p.add_argument("--telemetry", type=Path, default=None)
+    args = p.parse_args(argv)
+
+    plan_text = os.environ.get(WORKER_CHAOS_ENV)
+    injector = ChaosInjector(ChaosPlan.from_json(plan_text)) if plan_text else None
+    config = SimConfig.from_json(args.config.read_text())
+    hb = _Heartbeat(args.heartbeat, args.heartbeat_s, chaos=injector)
+    hb.start()  # first beat BEFORE the jax import: the lease covers startup
+
+    from .runner import run_simulation_config
+
+    recorder = TelemetryRecorder(args.telemetry) if args.telemetry else None
+    t0 = time.monotonic()
+    try:
+        res = run_simulation_config(
+            config,
+            use_all_devices=not args.single_device,
+            progress=hb.progress,
+            checkpoint_path=args.checkpoint,
+            telemetry=recorder,
+            chaos=injector,
+        )
+    finally:
+        if recorder is not None:
+            recorder.close()
+    # The exact run_sweep row schema (same key order), so fleet output diffs
+    # clean against a single-process sweep of the same grid.
+    row = {
+        **res.to_dict(),
+        "point": args.point,
+        "backend": "tpu",
+        "elapsed_s": round(time.monotonic() - t0, 3),
+    }
+    tmp = args.result.with_name(args.result.name + ".tmp")
+    tmp.write_text(json.dumps(row))
+    os.replace(tmp, args.result)  # atomic publish: the supervisor never
+    hb.stop()                     # reads a half-written row
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Supervisor side.
+
+
+def _read_tail_json(path: Path, nbytes: int = 4096) -> dict | None:
+    """Newest parseable JSON object from the tail of an append-only JSONL
+    file (the heartbeat read — cheap even on a long-lived beat file, and a
+    line torn by a SIGKILL mid-write never hides the beat before it)."""
+    try:
+        with path.open("rb") as fh:
+            fh.seek(0, 2)
+            size = fh.tell()
+            fh.seek(max(0, size - nbytes))
+            tail = fh.read().decode("utf-8", errors="replace")
+    except OSError:
+        return None
+    for line in reversed(tail.splitlines()):
+        if not line.strip():
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(row, dict):
+            return row
+    return None
+
+
+def _load_events(path: Path) -> list[dict]:
+    """Tolerant ledger read-back: skip torn/foreign lines, same policy as
+    telemetry.load_spans / the sweep ``--resume`` scanner."""
+    events: list[dict] = []
+    if not path.exists():
+        return events
+    for line in path.read_text(errors="replace").splitlines():
+        if not line.strip():
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(row, dict) and "event" in row:
+            events.append(row)
+    return events
+
+
+@dataclasses.dataclass
+class _Worker:
+    wid: str
+    point: str
+    attempt: int
+    proc: subprocess.Popen
+    hb_path: Path
+    row_path: Path
+    log_path: Path
+    spawned_t: float  # wall clock, the pre-first-beat liveness floor
+    last_hb: dict | None = None
+
+
+class FleetSupervisor:
+    """Dispatch ``points`` (the ``run_sweep`` point list) to up to
+    ``workers`` subprocess workers; survive theirs — and its own — deaths.
+
+    See the module docstring for the robustness contract. Everything
+    injectable for tests: ``worker_cmd`` builds a worker argv from an
+    assignment dict (the fake-worker harness), ``sleeper`` replaces the poll
+    sleep. ``worker_chaos`` is a :class:`~tpusim.chaos.ChaosPlan` (or
+    ``{point_name: plan}`` dict) injected via env into the attempt-0 worker
+    of the matching point(s) — ``worker_chaos_point`` restricts a single
+    plan to one named point."""
+
+    def __init__(
+        self,
+        points: Iterable[tuple[str, SimConfig]],
+        *,
+        workers: int = 2,
+        runs_scale: float = 1.0,
+        state_dir: str | Path,
+        out_path: str | Path | None = None,
+        lease_s: float = 120.0,
+        heartbeat_s: float = 1.0,
+        max_point_failures: int = 3,
+        backoff_s: float = 0.5,
+        backoff_cap_s: float = 30.0,
+        poll_s: float = 0.25,
+        status_interval_s: float = 2.0,
+        resume: bool = False,
+        quiet: bool = False,
+        single_device: bool = False,
+        telemetry_path: str | Path | None = None,
+        chaos=None,
+        worker_chaos=None,
+        worker_chaos_point: str | None = None,
+        worker_cmd: Callable[[dict[str, Any]], list[str]] | None = None,
+        sleeper: Callable[[float], None] | None = None,
+    ):
+        self.state_dir = Path(state_dir)
+        self.out_path = (
+            Path(out_path) if out_path is not None
+            else self.state_dir / "rows.jsonl"
+        )
+        self.ledger_path = self.state_dir / "fleet-ledger.jsonl"
+        self.workers = max(1, int(workers))
+        self.lease_s = lease_s
+        self.heartbeat_s = heartbeat_s
+        self.max_point_failures = max(1, int(max_point_failures))
+        self.backoff_s = backoff_s
+        self.backoff_cap_s = backoff_cap_s
+        self.poll_s = poll_s
+        self.status_interval_s = status_interval_s
+        self.resume = resume
+        self.quiet = quiet
+        self.single_device = single_device
+        self.chaos = as_injector(chaos)
+        if isinstance(worker_chaos, (str, Path)):
+            # Load ONCE, loud, at construction: a typo'd plan path deferred
+            # to spawn time would read as a transient spawn failure, and the
+            # "drill" would silently certify a healing path it never ran.
+            from .chaos import load_plan
+
+            worker_chaos = load_plan(worker_chaos)
+        self.worker_chaos = worker_chaos
+        self.worker_chaos_point = worker_chaos_point
+        self.worker_cmd = worker_cmd
+        self._sleep = sleeper if sleeper is not None else time.sleep
+
+        self.points: list[tuple[str, SimConfig]] = []
+        for name, config in points:
+            # Same scaling rule as run_sweep, so rows keep the same identity
+            # key (point, runs, backend) and --resume interoperates.
+            runs = max(1, int(config.runs * runs_scale))
+            self.points.append((name, dataclasses.replace(config, runs=runs)))
+        self._order = [name for name, _ in self.points]
+        if len(set(self._order)) != len(self._order):
+            raise ValueError("fleet points must have unique names")
+
+        self.recorder = (
+            TelemetryRecorder(telemetry_path) if telemetry_path is not None else None
+        )
+        if self.chaos is not None and self.recorder is not None:
+            self.chaos.bind_telemetry(self.recorder)
+            self.recorder.chaos = self.chaos
+
+        # Mutable run state.
+        self.live: list[_Worker] = []
+        self.failures: dict[str, int] = {}
+        self.quarantined: list[str] = []
+        self.requeues = 0
+        self._rows: dict[str, dict] = {}
+        self._attempts: dict[str, int] = {}
+        self._queue: list[str] = []
+        self._ready_at: dict[str, float] = {}
+        self._seq = 0
+        self._flush_idx = 0
+        self._flushed: set[str] = set()
+        self._done_prior: set[str] = set()
+        self._last_status_t = 0.0
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _emit(self, span: str, **attrs: Any) -> None:
+        if self.recorder is not None:
+            self.recorder.emit(span, **attrs)
+
+    def _log_event(self, event: str, **fields: Any) -> None:
+        row = {"event": event, "t": round(time.time(), 3), **fields}
+        append_jsonl_line(self.ledger_path, json.dumps(row))
+
+    def _say(self, msg: str) -> None:
+        if not self.quiet:
+            print(msg)
+
+    def _worker_plan(self, point: str, attempt: int) -> ChaosPlan | None:
+        """The chaos plan (if any) injected into this worker's environment.
+        Attempt 0 only: a replacement worker is a fresh process that would
+        re-arm every fault count and die at the same seam forever — the same
+        reason sweep recovery resumes WITHOUT the plan."""
+        if self.worker_chaos is None or attempt != 0:
+            return None
+        if isinstance(self.worker_chaos, dict):
+            return self.worker_chaos.get(point)
+        if self.worker_chaos_point is not None and point != self.worker_chaos_point:
+            return None
+        return self.worker_chaos
+
+    def _assignment(self, point: str, attempt: int, wid: str) -> dict[str, Any]:
+        workers_dir = self.state_dir / "workers"
+        return {
+            "point": point,
+            "attempt": attempt,
+            "worker": wid,
+            "config_path": self.state_dir / "points" / f"{point}.json",
+            "result_path": workers_dir / f"{wid}.row.json",
+            "heartbeat_path": workers_dir / f"{wid}.hb.jsonl",
+            "checkpoint_path": self.state_dir / "checkpoints" / f"{point}.npz",
+            "log_path": workers_dir / f"{wid}.log",
+            "telemetry_path": (
+                workers_dir / f"{wid}.tele.jsonl"
+                if self.recorder is not None else None
+            ),
+        }
+
+    def _default_worker_cmd(self, asg: dict[str, Any]) -> list[str]:
+        argv = [
+            sys.executable, "-m", "tpusim.fleet", "--worker",
+            "--point", asg["point"],
+            "--config", str(asg["config_path"]),
+            "--result", str(asg["result_path"]),
+            "--heartbeat", str(asg["heartbeat_path"]),
+            "--checkpoint", str(asg["checkpoint_path"]),
+            "--heartbeat-s", str(self.heartbeat_s),
+        ]
+        if self.single_device:
+            argv.append("--single-device")
+        if asg["telemetry_path"] is not None:
+            argv += ["--telemetry", str(asg["telemetry_path"])]
+        return argv
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _spawn(self, point: str) -> None:
+        attempt = self._attempts.get(point, 0)
+        self._attempts[point] = attempt + 1
+        wid = f"w{self._seq:03d}"
+        self._seq += 1
+        if self.chaos is not None:
+            # The fleet.spawn seam: "transient" = spawn failure (requeued by
+            # the caller), "sigkill" = the supervisor itself dies — leaving
+            # orphaned leases for the --resume drill.
+            self.chaos.fire("fleet.spawn", target=point, worker=wid, attempt=attempt)
+        asg = self._assignment(point, attempt, wid)
+        env = os.environ.copy()
+        # Workers import tpusim by module name; anchor the package parent on
+        # PYTHONPATH so the spawn works from any supervisor cwd.
+        pkg_parent = str(Path(__file__).resolve().parent.parent)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [pkg_parent] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+        )
+        plan = self._worker_plan(point, attempt)
+        if plan is not None:
+            env[WORKER_CHAOS_ENV] = plan.to_json()
+        else:
+            env.pop(WORKER_CHAOS_ENV, None)
+        argv = (self.worker_cmd or self._default_worker_cmd)(asg)
+        asg["result_path"].unlink(missing_ok=True)
+        with asg["log_path"].open("ab") as log:
+            proc = subprocess.Popen(
+                argv, env=env, stdout=log, stderr=subprocess.STDOUT
+            )
+        w = _Worker(
+            wid=wid, point=point, attempt=attempt, proc=proc,
+            hb_path=asg["heartbeat_path"], row_path=asg["result_path"],
+            log_path=asg["log_path"], spawned_t=time.time(),
+        )
+        self.live.append(w)
+        self._log_event(
+            "lease", point=point, worker=wid, attempt=attempt,
+            pid=proc.pid, lease_s=self.lease_s, chaos=plan is not None,
+        )
+        self._emit(
+            "fleet_spawn", target=point, worker=wid, attempt=attempt,
+            pid=proc.pid, worker_chaos=plan is not None,
+        )
+        self._say(f"[fleet] {wid} leased {point} (attempt {attempt})")
+
+    def _requeue(self, point: str, worker: str | None, reason: str) -> None:
+        failures = self.failures[point] = self.failures.get(point, 0) + 1
+        if failures >= self.max_point_failures:
+            # Poison-point semantics: quarantine LOUD with the name, keep
+            # draining the rest of the grid, exit nonzero at the end —
+            # never an infinite crash loop.
+            self.quarantined.append(point)
+            self._log_event(
+                "quarantine", point=point, failures=failures, reason=reason
+            )
+            self._emit(
+                "fleet_quarantine", target=point, failures=failures, reason=reason
+            )
+            msg = (
+                f"[fleet] QUARANTINED point {point!r} after {failures} "
+                f"consecutive worker failures (last: {reason}); its "
+                f"checkpoint stays in {self.state_dir / 'checkpoints'} for "
+                f"forensics — resume retries it with a fresh failure budget"
+            )
+            logger.error(msg)
+            print(msg, file=sys.stderr)
+            return
+        # Counted only when the point actually goes back on the queue, so
+        # the summary/fleet_status number always equals the ledger's count
+        # of "requeue" events (a quarantine is not a requeue).
+        self.requeues += 1
+        backoff = min(self.backoff_s * 2 ** (failures - 1), self.backoff_cap_s)
+        # Deterministic jitter (crc32, not salted hash()): drills reproduce,
+        # and a fleet of requeues still desynchronizes.
+        jitter = (zlib.crc32(f"{point}:{failures}".encode()) % 1000) / 1000.0
+        backoff *= 1.0 + 0.25 * jitter
+        self._ready_at[point] = time.time() + backoff
+        self._queue.append(point)
+        self._log_event(
+            "requeue", point=point, worker=worker, reason=reason,
+            failures=failures, backoff_s=round(backoff, 3),
+        )
+        self._emit(
+            "fleet_requeue", target=point, worker=worker, reason=reason,
+            failures=failures, backoff_s=round(backoff, 3),
+        )
+        self._say(
+            f"[fleet] requeued {point} ({reason}, failure {failures}/"
+            f"{self.max_point_failures}, backoff {backoff:.2f}s)"
+        )
+
+    def _poll_worker(self, w: _Worker, now: float) -> bool:
+        """Advance one live worker; True if it left the live set."""
+        rc = w.proc.poll()
+        if rc is None:
+            expired = False
+            hb = _read_tail_json(w.hb_path)
+            if self.chaos is not None:
+                try:
+                    self.chaos.fire(
+                        "fleet.heartbeat", target=w.point, worker=w.wid,
+                        attempt=w.attempt,
+                    )
+                except InjectedHang:
+                    # Supervisor-side drill: the lease reads as already
+                    # expired, without waiting out real wall clock.
+                    expired = True
+                except ChaosError:
+                    hb = None  # an injected failed heartbeat read
+            if hb is not None and isinstance(hb.get("t"), (int, float)):
+                w.last_hb = hb
+            beat_t = (w.last_hb or {}).get("t", 0.0)
+            age = now - max(w.spawned_t, float(beat_t))
+            if expired or age > self.lease_s:
+                # The watchdog: fetch_with_deadline's rule at process scope.
+                # SIGKILL, not SIGTERM — a wedged worker is past asking.
+                self._say(
+                    f"[fleet] {w.wid} lease expired on {w.point} "
+                    f"(no heartbeat for {age:.1f}s > {self.lease_s}s); killing"
+                )
+                try:
+                    w.proc.kill()
+                    w.proc.wait(timeout=10)
+                except (OSError, subprocess.TimeoutExpired):
+                    # TimeoutExpired: a D-state worker ignored even SIGKILL
+                    # (wedged NFS/tunnel I/O — the exact scenario this
+                    # watchdog exists for). Abandon the zombie and requeue;
+                    # crashing the supervisor here would take down every
+                    # other worker's supervision with it.
+                    pass
+                self.live.remove(w)
+                self._requeue(w.point, w.wid, "lease_expired")
+                return True
+            return False
+        self.live.remove(w)
+        if rc == 0:
+            try:
+                row = json.loads(w.row_path.read_text())
+                if not isinstance(row, dict):
+                    raise ValueError("result row is not an object")
+            except (OSError, ValueError) as e:
+                # Exit 0 with no publishable row is still a worker failure.
+                self._requeue(w.point, w.wid, f"bad_result:{type(e).__name__}")
+                return True
+            self._rows[w.point] = row
+            self.failures.pop(w.point, None)
+            self._log_event(
+                "done", point=w.point, worker=w.wid, attempt=w.attempt,
+                elapsed_s=row.get("elapsed_s"), runs=row.get("runs"),
+            )
+            self._emit(
+                "fleet_done", target=w.point, worker=w.wid, attempt=w.attempt,
+                elapsed_s=row.get("elapsed_s"), runs=row.get("runs"),
+            )
+            self._say(f"[fleet] {w.wid} finished {w.point}")
+        else:
+            self._requeue(w.point, w.wid, f"exit:{rc}")
+        return True
+
+    def _reap_orphan(self, ev: dict) -> bool:
+        """Kill a dead supervisor's still-running worker before re-leasing
+        its point: the supervisor-death drill (fleet.spawn sigkill) kills
+        only the supervisor, so an orphan worker may still be computing —
+        left alone it would race its replacement on the same checkpoint and
+        leak a full jax process. PID-reuse guard: kill ONLY a process whose
+        /proc cmdline carries BOTH the fleet-worker marker and THIS point's
+        name (a real worker's argv has both: `-m tpusim.fleet ... --point
+        <name>`); anything else — unreadable /proc, non-Linux, a recycled
+        pid now owned by another fleet's worker or an unrelated process
+        whose argv merely mentions the point — is left untouched and reads
+        as already-dead."""
+        pid = ev.get("pid")
+        if not isinstance(pid, int) or pid <= 0:
+            return False
+        try:
+            cmdline = Path(f"/proc/{pid}/cmdline").read_bytes().decode(
+                errors="replace"
+            )
+        except OSError:
+            return False
+        if "tpusim.fleet" not in cmdline or str(ev.get("point")) not in cmdline:
+            return False
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            return False
+        return True
+
+    def _flush_rows(self) -> None:
+        """Append buffered rows to ``out_path`` in POINT order (quarantined
+        and previously-done points are skipped), so a fleet output file is
+        line-for-line comparable with ``run_sweep``'s."""
+        quarantined = set(self.quarantined)
+        while self._flush_idx < len(self._order):
+            name = self._order[self._flush_idx]
+            if name in self._done_prior or name in quarantined:
+                self._flush_idx += 1
+                continue
+            row = self._rows.get(name)
+            if row is None:
+                return
+            if name not in self._flushed:
+                append_jsonl_line(self.out_path, json.dumps(row))
+                self._flushed.add(name)
+            self._flush_idx += 1
+
+    def _emit_status(self, now: float, force: bool = False) -> None:
+        if self.recorder is None:
+            return
+        if not force and now - self._last_status_t < self.status_interval_s:
+            return
+        self._last_status_t = now
+        leases = []
+        for w in self.live:
+            hb = w.last_hb or {}
+            beat_t = hb.get("t", w.spawned_t)
+            leases.append({
+                "point": w.point, "worker": w.wid, "attempt": w.attempt,
+                "age_s": round(now - max(w.spawned_t, float(beat_t)), 2),
+                "runs_done": hb.get("runs_done"),
+                "runs_total": hb.get("runs_total"),
+            })
+        self._emit(
+            "fleet_status",
+            workers=self.workers,
+            workers_alive=len(self.live),
+            queued=len(self._queue),
+            points_total=len(self._order),
+            points_done=len(self._rows) + len(self._done_prior),
+            requeues=self.requeues,
+            quarantined=list(self.quarantined),
+            leases=leases,
+        )
+
+    # -- the supervisor loop ----------------------------------------------
+
+    def run(self) -> dict[str, Any]:
+        t0_wall, t0 = time.time(), time.monotonic()
+        for sub in ("points", "checkpoints", "workers"):
+            (self.state_dir / sub).mkdir(parents=True, exist_ok=True)
+
+        done_keys: set[tuple[str, int, str]] = set()
+        if self.resume and self.out_path.exists():
+            for line in self.out_path.read_text(errors="replace").splitlines():
+                try:
+                    row = json.loads(line)
+                    done_keys.add((row["point"], row["runs"], row["backend"]))
+                except (json.JSONDecodeError, KeyError, TypeError):
+                    continue  # torn/foreign line: not done
+        orphans: list[dict] = []
+        if self.resume:
+            state: dict[str, dict] = {}
+            for ev in _load_events(self.ledger_path):
+                if ev["event"] in ("lease", "done") and "point" in ev:
+                    state[ev["point"]] = ev
+            orphans = [ev for ev in state.values() if ev["event"] == "lease"]
+
+        try:
+            for name, config in self.points:
+                if (name, config.runs, "tpu") in done_keys:
+                    self._done_prior.add(name)
+                    self._say(f"[fleet] {name} already in {self.out_path}; skipping")
+                    continue
+                (self.state_dir / "points" / f"{name}.json").write_text(
+                    config.to_json()
+                )
+                self._queue.append(name)
+            for ev in orphans:
+                if ev["point"] in self._queue:
+                    # Orphaned lease from a dead supervisor: the point is
+                    # requeued (its checkpoint resumes whatever the orphan
+                    # saved) with a fresh failure budget — a resume is an
+                    # operator decision, like re-running without --chaos.
+                    # A still-running orphan worker is reaped first, or it
+                    # would race its replacement on the same checkpoint.
+                    reaped = self._reap_orphan(ev)
+                    self._log_event(
+                        "adopt", point=ev["point"],
+                        prior_worker=ev.get("worker"), prior_pid=ev.get("pid"),
+                        reaped=reaped,
+                    )
+                    self._emit(
+                        "fleet_adopt", target=ev["point"],
+                        prior_worker=ev.get("worker"), reaped=reaped,
+                    )
+                    self._say(
+                        f"[fleet] adopted orphaned lease on {ev['point']} "
+                        f"(worker {ev.get('worker')} of a previous supervisor"
+                        + (", still running — killed)" if reaped else ")")
+                    )
+            self._log_event(
+                "fleet_start", points=len(self._order),
+                queued=len(self._queue), workers=self.workers,
+                resume=self.resume, run_id=getattr(self.recorder, "run_id", None),
+            )
+            self._emit_status(time.time(), force=True)
+
+            while self._queue or self.live:
+                now = time.time()
+                progressed = False
+                while len(self.live) < self.workers:
+                    ready = [
+                        p for p in self._queue
+                        if self._ready_at.get(p, 0.0) <= now
+                    ]
+                    if not ready:
+                        break
+                    point = ready[0]
+                    self._queue.remove(point)
+                    try:
+                        self._spawn(point)
+                    except (ChaosError, OSError) as e:
+                        self._requeue(point, None, f"spawn_failed:{e}")
+                    progressed = True
+                for w in list(self.live):
+                    if self._poll_worker(w, now):
+                        progressed = True
+                self._flush_rows()
+                self._emit_status(now, force=progressed)
+                if not progressed:
+                    self._sleep(self.poll_s)
+            self._flush_rows()
+
+            elapsed = time.monotonic() - t0
+            summary = {
+                "points_total": len(self._order),
+                "points_done": len(self._rows) + len(self._done_prior),
+                "quarantined": list(self.quarantined),
+                "requeues": self.requeues,
+                "workers_spawned": self._seq,
+                "elapsed_s": round(elapsed, 3),
+                "rows": [
+                    self._rows[n] for n in self._order if n in self._rows
+                ],
+            }
+            self._log_event(
+                "fleet_finish",
+                **{k: v for k, v in summary.items() if k != "rows"},
+            )
+            self._emit_status(time.time(), force=True)
+            # The closing span is named "run" so `tpusim watch` exits when
+            # the fleet completes, exactly as it does for a single run.
+            self._emit(
+                "run", t_start=t0_wall, dur_s=elapsed, fleet=True,
+                **{k: v for k, v in summary.items() if k != "rows"},
+            )
+            return summary
+        finally:
+            if self.recorder is not None:
+                self.recorder.close()
+
+
+# ---------------------------------------------------------------------------
+# CLI.
+
+
+def main(argv: list[str] | None = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "--worker":
+        return worker_main(argv[1:])
+
+    from .sweep import baseline_sweeps
+
+    sweeps = baseline_sweeps()
+    p = argparse.ArgumentParser(
+        prog="tpusim fleet",
+        description="Preemption-tolerant elastic sweep supervisor: dispatch "
+        "a baseline grid to N subprocess workers with leases, heartbeats, "
+        "a wall-clock watchdog, requeue-with-backoff and poison-point "
+        "quarantine. See tpusim.fleet.",
+    )
+    p.add_argument("sweep", choices=sorted(sweeps), help="which baseline grid")
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--runs-scale", type=float, default=1.0)
+    p.add_argument("--max-points", type=int, default=None)
+    p.add_argument(
+        "--batch-size", type=int, default=None,
+        help="override every point's batch size (sets checkpoint granularity "
+        "— statistics are batch-invariant)",
+    )
+    p.add_argument(
+        "--state-dir", type=Path, required=True,
+        help="fleet state: work ledger, per-point configs/checkpoints, "
+        "per-worker heartbeat/result/log files",
+    )
+    p.add_argument(
+        "--out", type=Path, default=None,
+        help="result rows JSONL (default STATE_DIR/rows.jsonl); same schema "
+        "and point order as python -m tpusim.sweep",
+    )
+    p.add_argument(
+        "--lease-s", type=float, default=120.0,
+        help="wall-clock watchdog: a worker with no heartbeat for this long "
+        "is SIGKILLed and its point requeued (default 120)",
+    )
+    p.add_argument("--heartbeat-s", type=float, default=1.0)
+    p.add_argument(
+        "--max-point-failures", type=int, default=3,
+        help="consecutive worker deaths before a point is quarantined loud",
+    )
+    p.add_argument("--backoff-s", type=float, default=0.5)
+    p.add_argument(
+        "--resume", action="store_true",
+        help="skip points whose rows already landed in --out and re-adopt "
+        "orphaned leases from the work ledger (supervisor crash recovery); "
+        "quarantined points retry with a fresh failure budget",
+    )
+    p.add_argument("--telemetry", type=Path, metavar="JSONL")
+    p.add_argument(
+        "--chaos", type=Path, metavar="PLAN",
+        help="supervisor-side chaos plan (fleet.spawn / fleet.heartbeat "
+        "seams)",
+    )
+    p.add_argument(
+        "--worker-chaos", type=Path, metavar="PLAN",
+        help="chaos plan injected (via env) into the attempt-0 worker of "
+        "each point — the worker-kill drill; replacement workers run clean",
+    )
+    p.add_argument(
+        "--worker-chaos-point", default=None, metavar="NAME",
+        help="restrict --worker-chaos to one named point",
+    )
+    p.add_argument("--single-device", action="store_true")
+    p.add_argument("--no-probe", action="store_true")
+    p.add_argument("--quiet", action="store_true")
+    args = p.parse_args(argv)
+
+    if not args.no_probe:
+        # Same pre-flight rule as the sweep CLI: prove the backend from a
+        # killable subprocess before committing a fleet to it.
+        from .probe import probe_backend
+
+        platform = probe_backend()
+        if platform is None:
+            print(
+                "error: accelerator backend unavailable after probe retries; "
+                "re-run later or with --no-probe",
+                file=sys.stderr,
+            )
+            return 2
+        if platform != "tpu":
+            print(
+                f"warning: no TPU visible (platform={platform}); fleet "
+                f"workers will run on {platform}",
+                file=sys.stderr,
+            )
+
+    points = sweeps[args.sweep]()
+    if args.max_points is not None:
+        points = points[: args.max_points]
+    if args.batch_size is not None:
+        points = [
+            (n, dataclasses.replace(c, batch_size=args.batch_size))
+            for n, c in points
+        ]
+
+    chaos = None
+    if args.chaos is not None:
+        from .chaos import load_plan
+
+        chaos = ChaosInjector(load_plan(args.chaos))
+
+    sup = FleetSupervisor(
+        points,
+        workers=args.workers,
+        runs_scale=args.runs_scale,
+        state_dir=args.state_dir,
+        out_path=args.out,
+        lease_s=args.lease_s,
+        heartbeat_s=args.heartbeat_s,
+        max_point_failures=args.max_point_failures,
+        backoff_s=args.backoff_s,
+        resume=args.resume,
+        quiet=args.quiet,
+        single_device=args.single_device,
+        telemetry_path=args.telemetry,
+        chaos=chaos,
+        worker_chaos=args.worker_chaos,
+        worker_chaos_point=args.worker_chaos_point,
+    )
+    summary = sup.run()
+    if not args.quiet:
+        print(
+            f"[fleet] {summary['points_done']}/{summary['points_total']} "
+            f"points done, {summary['requeues']} requeue(s), "
+            f"{len(summary['quarantined'])} quarantined, "
+            f"{summary['workers_spawned']} worker(s) spawned "
+            f"in {summary['elapsed_s']}s"
+        )
+    return 3 if summary["quarantined"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

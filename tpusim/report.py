@@ -439,6 +439,48 @@ def render_report(spans: list[dict], fmt: str = "text") -> str:
                 traj.append(row)
             table(["runs", *stat_names], traj)
 
+    from .fleet import summarize_fleet_spans
+
+    fleet = summarize_fleet_spans(spans)
+    if fleet is not None:
+        # Fleet supervisor panels (tpusim.fleet): worker lifecycle, lease
+        # state and the requeue/quarantine ledger of an elastic sweep —
+        # extracted by the SAME summarizer `tpusim watch` renders from.
+        heading("Fleet (worker supervisor)")
+        rows = [
+            ["points done",
+             f"{fleet['points_done']}"
+             + (f" / {fleet['points_total']}" if fleet["points_total"] else "")],
+            ["workers spawned", str(fleet["spawns"])],
+            ["workers alive (last status)",
+             str(fleet["workers_alive"] if fleet["workers_alive"] is not None else "n/a")],
+            ["requeues", str(len(fleet["requeues"]))],
+            ["orphaned leases adopted", str(fleet["adopts"])],
+            ["quarantined", ", ".join(fleet["quarantined"]) or "none"],
+        ]
+        table(["counter", "value"], rows)
+        if fleet["requeues"]:
+            table(
+                ["requeued point", "worker", "reason", "failures", "backoff"],
+                [
+                    [str(a.get("target", "?")), str(a.get("worker")),
+                     str(a.get("reason", "?")), str(a.get("failures", "?")),
+                     f"{a.get('backoff_s', 0)} s"]
+                    for a in fleet["requeues"]
+                ],
+            )
+        if fleet["leases"]:
+            table(
+                ["leased point (last status)", "worker", "attempt", "beat age", "progress"],
+                [
+                    [str(l.get("point", "?")), str(l.get("worker", "?")),
+                     str(l.get("attempt", "?")), f"{l.get('age_s', '?')} s",
+                     (f"{l['runs_done']}/{l.get('runs_total', '?')}"
+                      if l.get("runs_done") is not None else "n/a")]
+                    for l in fleet["leases"]
+                ],
+            )
+
     faults = [sp for sp in spans if sp["span"] == "chaos"]
     if faults:
         # The fault ledger: every injected fault of a chaos drill

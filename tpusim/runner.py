@@ -241,6 +241,7 @@ def run_simulation_config(
     engine_cache: dict | None = None,
     chaos=None,
     ci_target_rel: float = 0.01,
+    ci_target_stat: str | None = None,
 ) -> SimResults:
     """Run ``config.runs`` simulations and aggregate their statistics.
 
@@ -295,9 +296,42 @@ def run_simulation_config(
     are session-scoped (a checkpoint resume restarts them) and
     multi-controller meshes emit none. This is the estimator substrate the
     ROADMAP's adaptive-precision driver consumes.
+
+    **Run-until-confident** — ``ci_target_stat`` (one of the
+    tpusim.convergence statistics: ``blocks_found``/``blocks_share``/
+    ``stale_rate``) arms the adaptive-precision DRIVER on that substrate:
+    the batch loop stops as soon as the statistic's worst relative 95 % CI
+    half-width (across miners) crosses ``ci_target_rel``, instead of only
+    displaying an ETA. The run then reports the statistics of the runs it
+    actually executed (``SimResults.runs``), and the closing ``run`` span
+    records ``stop_reason`` (``"ci_target"`` or ``"runs_exhausted"``) and
+    ``converged`` (whether the target was met — also recorded when the run
+    exhausted ``config.runs`` without reaching it). ``config.runs`` remains
+    the budget ceiling; None (the default) keeps the fixed-run behavior.
     """
     if engine not in ("auto", "pallas", "scan"):
         raise ValueError(f"unknown engine {engine!r}; use auto, pallas or scan")
+    if ci_target_stat is not None:
+        from .convergence import STATS
+
+        known = tuple(s for s, _, _ in STATS)
+        if ci_target_stat not in known:
+            raise ValueError(
+                f"unknown ci_target_stat {ci_target_stat!r}; use one of {known}"
+            )
+        if not (ci_target_rel and ci_target_rel > 0):
+            raise ValueError(
+                "ci_target_stat needs a positive ci_target_rel to stop at"
+            )
+        if jax.process_count() > 1:
+            # Multi-controller meshes drop the moment leaves (same policy as
+            # the flight ring), so the stop condition could never fire —
+            # refuse loudly rather than silently burning the full budget.
+            raise ValueError(
+                "ci_target_stat needs the streaming-moment substrate, which "
+                "multi-controller meshes do not emit; run single-controller "
+                "or drop the stop target"
+            )
     chaos = as_injector(chaos)
     if chaos is not None and telemetry is not None:
         chaos.bind_telemetry(telemetry)
@@ -408,6 +442,11 @@ def run_simulation_config(
         # so it is excluded — the steady_is_first_batch discipline).
         moments = MomentAccumulator()
         steady_rate = {"runs": 0, "s": 0.0}
+        # Adaptive-precision driver state (ci_target_stat): the loop's stop
+        # verdict plus the last observed relative half-width, reported as
+        # stop_reason/converged on the closing run span.
+        stop_reason = "runs_exhausted"
+        last_rel: float | None = None
 
         def finalize_with_retries(fin, this_engine, keys, start: int):
             """Block on an async batch and apply the retry/fallback policy; a
@@ -620,13 +659,21 @@ def run_simulation_config(
                     # the ETA off by the compile-to-compute ratio.
                     steady_rate["runs"] += nb
                     steady_rate["s"] += now - last_done
-                if telemetry is not None and stats_b:
+                snap = None
+                if stats_b and (telemetry is not None or ci_target_stat is not None):
                     rate_is_first_batch = steady_rate["s"] <= 0.0
                     rate = (
                         steady_rate["runs"] / steady_rate["s"]
                         if not rate_is_first_batch
                         else nb / max(now - last_done, 1e-9)
                     )
+                    # One snapshot feeds both consumers: the stats span and
+                    # the run-until-confident stop check below — they can
+                    # never disagree about the CI state they acted on.
+                    snap = moments.snapshot(
+                        target_rel_hw=ci_target_rel, rate_runs_per_s=rate
+                    )
+                if telemetry is not None and snap is not None:
                     telemetry.emit(
                         # runs = the accumulator's session scope (what the CI
                         # numbers derive from); runs_done = the run-level
@@ -639,9 +686,7 @@ def run_simulation_config(
                         target_rel_hw=ci_target_rel,
                         rate_runs_per_s=round(rate, 3),
                         rate_is_first_batch=rate_is_first_batch,
-                        stats=moments.snapshot(
-                            target_rel_hw=ci_target_rel, rate_runs_per_s=rate
-                        ),
+                        stats=snap,
                     )
                 last_done = now
                 if compile_s is None:
@@ -661,6 +706,18 @@ def run_simulation_config(
                         )
                 if progress is not None:
                     progress(runs_done, config.runs)
+                if ci_target_stat is not None and snap is not None:
+                    rel = (snap.get(ci_target_stat) or {}).get("rel_hw_max")
+                    if isinstance(rel, (int, float)):
+                        last_rel = float(rel)
+                        if last_rel <= ci_target_rel:
+                            # Run-until-confident: the target statistic's CI
+                            # crossed the requested width — stop dispatching
+                            # and abandon the in-flight batch (its sums were
+                            # never folded, so the reported statistics cover
+                            # exactly runs_done runs).
+                            stop_reason = "ci_target"
+                            break
             pending = nxt
     finally:
         # The listener registration is process-global (no unregister in
@@ -671,6 +728,13 @@ def run_simulation_config(
 
     elapsed = time.monotonic() - t0
     assert sums is not None
+    converged = None
+    if ci_target_stat is not None:
+        # converged is also meaningful when the run EXHAUSTED its budget: the
+        # closing span then says whether the target happened to be met anyway.
+        converged = stop_reason == "ci_target" or (
+            last_rel is not None and last_rel <= ci_target_rel
+        )
     if telemetry is not None:
         from .telemetry import environment_attrs
 
@@ -692,6 +756,8 @@ def run_simulation_config(
             block_interval_s=config.network.block_interval_s,
             batch_size=batch, mode=config.resolved_mode,
             engine=type(eng).__name__, compile_s=round(compile_s or 0.0, 4),
+            stop_reason=stop_reason, converged=converged,
+            ci_target_stat=ci_target_stat,
             occupancy=occupancy, **tele_run, **hists, **ledger_attrs,
             # Environment identity: cross-host ledgers must be
             # self-describing (the ROADMAP's drift note, now machine-read).

@@ -235,19 +235,12 @@ def run_sweep(
         }
         results.append(row)
         if out_path is not None:
-            # A killed window can leave a truncated final line with no
-            # newline; appending directly would glue this row onto the
-            # fragment and make both unparseable. Probe/repair the trailing
-            # byte through a separate BINARY handle: text-mode tell() returns
-            # an opaque cookie on which arithmetic is undefined (io docs) and
-            # could mis-seek if a row ever contains non-ASCII.
-            if out_path.exists() and out_path.stat().st_size > 0:
-                with out_path.open("rb+") as bh:
-                    bh.seek(-1, 2)
-                    if bh.read(1) != b"\n":
-                        bh.write(b"\n")
-            with out_path.open("a") as fh:
-                fh.write(json.dumps(row) + "\n")
+            # Torn-trailing-line repair before every append (a killed window
+            # can cut the previous row mid-write) — the shared discipline of
+            # telemetry.append_jsonl_line, also used by the fleet ledger.
+            from .telemetry import append_jsonl_line
+
+            append_jsonl_line(out_path, json.dumps(row))
         if recorder is not None:
             recorder.emit(
                 "sweep_point", t_start=time.time() - row["elapsed_s"],

@@ -53,11 +53,34 @@ __all__ = [
     "BatchRecord",
     "CompileLedger",
     "throughput_report",
+    "append_jsonl_line",
     "load_spans",
     "new_run_id",
     "environment_attrs",
     "device_memory_attrs",
 ]
+
+
+def append_jsonl_line(path: str | Path, line: str) -> None:
+    """Append one line to an append-only JSONL file, repairing a torn trailing
+    line first: a killed window (``timeout -k`` mid-write, a preempted VM) can
+    leave the file's final line truncated with no newline, and appending
+    directly would glue the new row onto the fragment and make both
+    unparseable. The trailing byte is probed/repaired through a separate
+    BINARY handle: text-mode ``tell()`` returns an opaque cookie on which
+    arithmetic is undefined (io docs) and could mis-seek if a row ever
+    contains non-ASCII. THE shared append discipline behind the sweep row
+    writer (tpusim.sweep) and the fleet supervisor's work ledger
+    (tpusim.fleet) — crash tolerance on the write side, pairing
+    :func:`load_spans`-style tolerance on the read side."""
+    path = Path(path)
+    if path.exists() and path.stat().st_size > 0:
+        with path.open("rb+") as bh:
+            bh.seek(-1, 2)
+            if bh.read(1) != b"\n":
+                bh.write(b"\n")
+    with path.open("a") as fh:
+        fh.write(line.rstrip("\n") + "\n")
 
 
 def environment_attrs() -> dict[str, Any]:

@@ -150,6 +150,33 @@ def render_watch(spans: list[dict], source: str, now: float | None = None) -> st
                 )
         out.append(" · ".join(parts))
 
+    # --- Fleet supervisor (tpusim.fleet): the elastic-sweep live state —
+    # workers alive, leases and their beat progress, requeues, quarantines.
+    # Same summarizer as the report panel, so the surfaces cannot drift.
+    from .fleet import summarize_fleet_spans
+
+    fleet = summarize_fleet_spans(mine)
+    if fleet is not None:
+        def orq(v):  # a foreign/partial status renders "?", never a crash
+            return "?" if v is None else v
+
+        line = (
+            f"fleet: {orq(fleet['workers_alive'])} worker(s) alive · "
+            f"{orq(fleet['points_done'])}/{orq(fleet['points_total'])} points"
+            f" · {orq(fleet['queued'])} queued · {len(fleet['requeues'])} requeue(s)"
+        )
+        if fleet["quarantined"]:
+            line += f" · QUARANTINED: {', '.join(fleet['quarantined'])}"
+        out.append(line)
+        if fleet["leases"]:
+            parts = []
+            for entry in fleet["leases"]:
+                lease = f"{entry.get('point', '?')}->{entry.get('worker', '?')}"
+                if entry.get("runs_done") is not None:
+                    lease += f" ({entry['runs_done']}/{entry.get('runs_total', '?')})"
+                parts.append(lease)
+            out.append("  leases: " + ", ".join(parts))
+
     # --- Convergence (the stats spans this dashboard exists for).
     out.append("")
     if sstats:
@@ -219,8 +246,19 @@ def main(argv: list[str] | None = None) -> int:
         "--no-clear", action="store_true",
         help="append frames instead of repainting (dumb terminals / logs)",
     )
+    ap.add_argument(
+        "--wait-for-file", type=float, default=0.0, metavar="S",
+        help="poll up to S seconds for the ledger file to appear before "
+        "rendering (bounded) — lets a fleet drill or CI start the watcher "
+        "BEFORE the supervisor/run creates the ledger; --once still exits "
+        "rc 2 if the file never appears within the bound",
+    )
     args = ap.parse_args(argv)
 
+    if args.wait_for_file > 0 and not args.path.exists():
+        deadline = time.monotonic() + args.wait_for_file
+        while not args.path.exists() and time.monotonic() < deadline:
+            time.sleep(min(0.2, args.wait_for_file))
     if args.once and not args.path.exists():
         print(f"error: {args.path} does not exist", file=sys.stderr)
         return 2
