@@ -476,6 +476,8 @@ def main() -> int:
         # path and state layout this number was measured on.
         info["rng_batch"] = config.rng_batch
         info["state_dtype"] = config.resolved_count_dtype
+        info["consensus_gather"] = config.consensus_gather
+        info["count_rebase"] = config.count_rebase
 
         phase = "headline-compile"
         # Compile + warm up (first TPU compile is slow and must not be timed).
@@ -556,6 +558,8 @@ def main() -> int:
                 "pipelined": not args.no_pipeline,
                 "rng_batch": exact_cfg.rng_batch,
                 "state_dtype": exact_cfg.resolved_count_dtype,
+                "consensus_gather": exact_cfg.consensus_gather,
+                "count_rebase": exact_cfg.count_rebase,
             }
             t0 = time.monotonic()
             try:
@@ -644,6 +648,8 @@ def main() -> int:
                         "pipelined": info["pipelined"],
                         "rng_batch": info["rng_batch"],
                         "state_dtype": info["state_dtype"],
+                        "consensus_gather": info["consensus_gather"],
+                        "count_rebase": info["count_rebase"],
                     },
                     extra={"elapsed_s": round(elapsed, 2), "runs": total_runs},
                 )]
@@ -660,6 +666,8 @@ def main() -> int:
                             "pipelined": einfo["pipelined"],
                             "rng_batch": einfo["rng_batch"],
                             "state_dtype": einfo["state_dtype"],
+                            "consensus_gather": einfo["consensus_gather"],
+                            "count_rebase": einfo["count_rebase"],
                         },
                         extra={"elapsed_s": einfo["elapsed_s"],
                                "runs": einfo["runs"]},

@@ -42,15 +42,22 @@ env JAX_PLATFORMS=cpu python -m tpusim report "$chaos_dir/drill.jsonl" \
   | grep -q "Fault ledger (injected chaos)"
 rm -rf "$chaos_dir"
 
-echo "== perf guard (batched RNG + packed state) =="
-# The PR-6 hot-path contracts, as a standalone leg so a regression is named
-# in CI output: (a) the default (flight_capacity=0) device-loop program
-# still carries ZERO recorder machinery with the packed/batched state
-# leaves (jaxpr program-text check — no ring tensor, no slot modulo), and
-# (b) the warmed batched-RNG dispatch paths recompile exactly never.
+echo "== perf guard (batched RNG + packed state + gathers + count rebase) =="
+# The PR-6/PR-10 hot-path contracts, as a standalone leg so a regression is
+# named in CI output: (a) the default (flight_capacity=0) device-loop
+# program still carries ZERO recorder machinery with the packed/batched
+# state leaves (jaxpr program-text check — no ring tensor, no slot modulo);
+# (b) the warmed batched-RNG dispatch paths recompile exactly never;
+# (c) the consensus_gather program carries NO legacy one-hot contraction
+# muls over the (R, M, M[, M]) consensus tensors (and the legacy program
+# still does — the check cannot rot into a tautology); (d) gather reads and
+# per-chunk count re-basing are bit-equal to the legacy one-hot / un-rebased
+# int32 programs, fast AND exact-selfish.
 env JAX_PLATFORMS=cpu python - <<'EOF'
+import dataclasses, re
+import numpy as np
 import jax
-from tpusim.config import SimConfig, default_network
+from tpusim.config import SimConfig, default_network, reference_selfish_network
 from tpusim.engine import Engine
 from tpusim.flight import N_FIELDS
 from tpusim.runner import make_run_keys
@@ -62,17 +69,42 @@ assert cfg.rng_batch and cfg.resolved_count_dtype == "int16", (
     cfg.rng_batch, cfg.resolved_count_dtype)
 keys = make_run_keys(0, 0, 8)
 
-def loop_jaxpr(c):
+def loop_jaxpr(c, n=8):
     eng = Engine(c)
-    hi, lo = eng._ledger_init(8)
+    hi, lo = eng._ledger_init(n)
     return str(jax.make_jaxpr(lambda k: eng._device_loop(k, hi, lo, eng.params))(keys))
 
-import dataclasses
 off = loop_jaxpr(cfg)
 on = loop_jaxpr(dataclasses.replace(cfg, flight_capacity=7))
 marker = f"7,{N_FIELDS}]"
 assert " rem " not in off and marker not in off, "recorder leaked into cap=0 program"
 assert " rem " in on and marker in on, "recorder missing from cap>0 program"
+
+# (c) one-hot contraction ops absent when consensus_gather is on.
+exact = SimConfig(network=reference_selfish_network(), mode="exact",
+                  duration_ms=4 * 86_400_000, runs=8, batch_size=8,
+                  chunk_steps=64, seed=3, count_rebase=False)
+contraction = re.compile(r":i16\[8,9,9(,9)?\] = mul")
+gat = loop_jaxpr(exact)
+leg = loop_jaxpr(dataclasses.replace(exact, consensus_gather=False))
+assert not contraction.search(gat) and " gather[" in gat, \
+    "one-hot contraction leaked into the gather program"
+assert contraction.search(leg) and " gather[" not in leg, \
+    "legacy program lost its contraction signature (dead check)"
+
+# (d) gather + count-rebase bit-equality pins.
+for name, base in (("fast", dataclasses.replace(cfg, duration_ms=4 * 86_400_000)),
+                   ("exact", exact)):
+    kk = make_run_keys(base.seed, 0, 8)
+    legacy = Engine(dataclasses.replace(
+        base, consensus_gather=False, count_rebase=False,
+        state_dtype="int32")).run_batch(kk)
+    new = Engine(dataclasses.replace(base, count_rebase=True)).run_batch(kk)
+    assert legacy.keys() == new.keys()
+    for key in legacy:
+        np.testing.assert_array_equal(
+            np.asarray(legacy[key]), np.asarray(new[key]),
+            err_msg=f"{name}: {key}")
 
 eng = Engine(cfg)
 eng.run_batch(keys)
@@ -80,7 +112,7 @@ eng.run_batch(keys, pipelined=True)
 with compile_count_guard(exact=0):
     eng.run_batch(keys)
     eng.run_batch(keys, pipelined=True)
-print("perf guard: compiled-out recorder + zero warm recompiles OK")
+print("perf guard: compiled-out recorder + gather/rebase pins + zero warm recompiles OK")
 EOF
 
 echo "== telemetry smoke =="

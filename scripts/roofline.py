@@ -112,26 +112,36 @@ def measure_points(args, platform: str, bandwidth_gbps: float) -> list[dict]:
         "fast": default_network(propagation_ms=1000),
         "exact": reference_selfish_network(),
     }
-    # Headline duration (365 d: the count bound exceeds int16, state stays
-    # int32) plus one packed-state variant per mode at the largest batch: the
-    # shorter duration flips SimConfig.state_dtype="auto" to int16, shrinking
-    # bytes/event — the chained-chunk timing itself is duration-independent
-    # (every chunk runs at the full TIME_CAP cap), so the packed rows isolate
-    # exactly the layout effect.
-    variants = [(365 * 86_400_000, args.batch_list)]
+    # Headline duration (365 d — int16-REBASED under the default
+    # count_rebase: per-chunk count re-basing keeps the bound per-chunk, so
+    # "auto" packs year-long runs) plus two comparison variants at the
+    # largest batch: the legacy int32 un-rebased year-long layout (the
+    # pre-rebase program, kept so the report shows what re-basing bought)
+    # and the short-duration packed row (int16 WITHOUT re-basing — the
+    # historical packed domain). The chained-chunk timing itself is
+    # duration-independent (every chunk runs at the full TIME_CAP cap), so
+    # these rows isolate exactly the layout effect.
+    variants = [(365 * 86_400_000, args.batch_list, {})]
+    variants.append((
+        365 * 86_400_000, [max(args.batch_list)],
+        {"state_dtype": "int32", "count_rebase": False},
+    ))
     if args.packed_days > 0:
-        variants.append((args.packed_days * 86_400_000, [max(args.batch_list)]))
+        variants.append((
+            args.packed_days * 86_400_000, [max(args.batch_list)],
+            {"count_rebase": False},
+        ))
     points = []
     for mode in args.modes:
         net = nets[mode]
-        for duration_ms, batches in variants:
+        for duration_ms, batches, overrides in variants:
             for batch in batches:
                 keys = make_run_keys(7, 0, batch)
                 for k in args.k_list:
                     cfg = SimConfig(
                         network=net, duration_ms=duration_ms, runs=batch,
                         batch_size=batch, seed=7, chunk_steps=args.chunk_steps,
-                        superstep=k,
+                        superstep=k, **overrides,
                     )
                     engines = [Engine(cfg)]
                     if platform == "tpu":
@@ -156,7 +166,10 @@ def measure_points(args, platform: str, bandwidth_gbps: float) -> list[dict]:
                                 f"K={k}: degenerate timing, dropped"
                             )
                             continue
-                        p.update(platform=platform, batch=batch)
+                        p.update(
+                            platform=platform, batch=batch,
+                            duration_days=round(duration_ms / 86_400_000.0),
+                        )
                         points.append(p)
                         log(
                             f"{mode}/{type(eng).__name__}[{p['state_dtype']}] "
@@ -193,10 +206,14 @@ def render_md(doc: dict) -> str:
         "  `bytes/event = 2 x state / chunk_steps + 8`.",
         "",
         "`state` is dtype-aware: packed-state rows (`SimConfig.state_dtype`,",
-        "int16 count leaves whenever the duration-derived bound provably",
-        "fits — up to ~106 d at the 600 s interval) carry roughly half the",
-        "count-leaf bytes, i.e. packing RAISES the roof where it applies,",
-        "while batched RNG and supersteps close the distance to it.",
+        "int16 count leaves whenever the count bound provably fits — up to",
+        "~106.8 d at the 600 s interval un-rebased, and year-long-plus under",
+        "the default `SimConfig.count_rebase`, which re-bases the count",
+        "leaves per chunk so the bound stops growing with duration) carry",
+        "roughly half the count-leaf bytes, i.e. packing RAISES the roof",
+        "where it applies, while batched RNG and supersteps close the",
+        "distance to it. `int16+rebase` rows are the year-long packed",
+        "layout; plain `int16` rows are the short-duration packed domain.",
         "",
         f"Measured copy bandwidth (STREAM-style jitted saxpy, read+write): "
         f"**{bw:.1f} GB/s** on this host"
@@ -209,12 +226,21 @@ def render_md(doc: dict) -> str:
         "",
         "## Measured points",
         "",
-        "| engine | mode | dtype | batch | K | events/s | bytes/event | roof events/s | % of roof |",
-        "|---|---|---|---:|---:|---:|---:|---:|---:|",
+        "| engine | mode | dtype | days | batch | K | events/s | bytes/event | roof events/s | % of roof |",
+        "|---|---|---|---:|---:|---:|---:|---:|---:|---:|",
     ]
+
+    def dtype_cell(p):
+        # int16 appears in TWO domains now: the short-duration packed rows
+        # and the year-long count-rebased ones — mark the re-based layout.
+        d = p.get("state_dtype", "int32")
+        return f"{d}+rebase" if p.get("count_rebase") else d
+
     for p in doc["points"]:
+        days = p.get("duration_days")
         lines.append(
-            f"| {p['engine']} | {p['mode']} | {p.get('state_dtype', 'int32')} "
+            f"| {p['engine']} | {p['mode']} | {dtype_cell(p)} "
+            f"| {days if days is not None else ''} "
             f"| {p.get('batch') or ''} "
             f"| {p['superstep']} | {p['events_per_s']:,.0f} "
             f"| {p['bytes_per_event']:.0f} | {p['roof_events_per_s']:,.0f} "
@@ -223,7 +249,7 @@ def render_md(doc: dict) -> str:
     for p in doc.get("cached_tpu_points", []):
         lines.append(
             f"| {p['engine']} ({p['measurement']}) | {p['mode']} "
-            f"| {p.get('state_dtype', 'int32')} |  "
+            f"| {dtype_cell(p)} |  |  "
             f"| {p['superstep']} | {p['events_per_s']:,.0f} "
             f"| {p['bytes_per_event']:.0f} | {p['roof_events_per_s']:,.0f} "
             f"| {100 * p['fraction_of_roof']:.2f}% |"
@@ -240,13 +266,16 @@ def render_md(doc: dict) -> str:
             f" event rate ({best['roof_events_per_s']:,.0f} events/s at "
             f"{best['bytes_per_event']:.0f} bytes/event). The PR-6 batched "
             "wide RNG (sampler mapping hoisted out of the event loop, "
-            "`SimConfig.rng_batch`) and the fused adoption select attack the "
-            "remaining control/compute gap; packed int16 state "
-            "(`SimConfig.state_dtype`, the int16 rows above) attacks the "
-            "traffic itself where the duration bound admits it. What is "
-            "left at int32/365 d is dominated by the pairwise consensus "
-            "update's (M, M) passes — measured by ablation at ~60% of the "
-            "fast step — i.e. compute per event, not layout.",
+            "`SimConfig.rng_batch`) and the fused adoption select attacked "
+            "the control/compute gap; packed int16 state "
+            "(`SimConfig.state_dtype`) attacks the traffic itself, and the "
+            "`int16+rebase` rows extend it to year-long runs "
+            "(`SimConfig.count_rebase`). The per-event consensus compute "
+            "that ablation put at ~60% of the fast step is now addressed by "
+            "the miner-axis gather reads (`SimConfig.consensus_gather`): "
+            "the one-hot contract-and-sum reads of the best owner's rows "
+            "became dynamic-index moves (O(M^2) -> O(M) fast, O(M^3) -> "
+            "O(M^2) exact).",
         ]
     pallas_rows = [
         p for p in doc["points"] + doc.get("cached_tpu_points", [])

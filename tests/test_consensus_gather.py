@@ -1,0 +1,255 @@
+"""Miner-axis consensus gathers (SimConfig.consensus_gather) and per-chunk
+count re-basing (SimConfig.count_rebase): both pure compile-time performance
+knobs, pinned here to be observationally invisible — every statistic, counter,
+streaming moment and flight row is bit-identical to the legacy one-hot /
+un-rebased int32 programs, checkpoints resume across both knobs, and the
+gather program provably carries no one-hot contraction ops.
+
+The re-basing pins are the int16 domain extension's safety net: a year-long
+reference run (which the un-rebased bound rejects at ~106.8 d) must resolve
+``resolved_count_dtype == "int16"`` and reproduce the int32 un-rebased run
+bit for bit after the final_stats re-add.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+import numpy as np
+import jax
+import pytest
+
+from tpusim.config import (
+    INT16_MAX_DURATION_MS_600S,
+    TIME_CAP_MS,
+    SimConfig,
+    default_network,
+    reference_selfish_network,
+)
+from tpusim.engine import Engine
+from tpusim.runner import make_run_keys
+
+FAST = SimConfig(
+    network=default_network(propagation_ms=10_000),  # racy: arrivals matter
+    duration_ms=4 * 86_400_000,
+    runs=32,
+    batch_size=32,
+    chunk_steps=128,
+    seed=23,
+)
+EXACT = dataclasses.replace(
+    FAST, network=reference_selfish_network(), mode="exact", runs=16,
+    batch_size=16,
+)
+
+#: The pre-knob program: one-hot reads, un-rebased int32 counts.
+LEGACY = dict(consensus_gather=False, count_rebase=False, state_dtype="int32")
+
+
+def _assert_sums_equal(a: dict, b: dict, msg: str) -> None:
+    assert a.keys() == b.keys()
+    for name in a:
+        np.testing.assert_array_equal(
+            np.asarray(a[name]), np.asarray(b[name]), err_msg=f"{msg}: {name}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Gather reads == one-hot contractions, bit for bit.
+
+
+@pytest.mark.parametrize("config", [FAST, EXACT], ids=["fast", "exact-selfish"])
+@pytest.mark.parametrize("k", [1, 2])
+def test_gather_vs_onehot_bit_equal(config, k):
+    """The gather path reads exactly the entries the one-hot contraction
+    summed, across honest and selfish rosters and superstep widths."""
+    cfg = dataclasses.replace(config, superstep=k, count_rebase=False)
+    keys = make_run_keys(cfg.seed, 0, cfg.runs)
+    onehot = Engine(dataclasses.replace(cfg, consensus_gather=False)).run_batch(keys)
+    gather = Engine(cfg).run_batch(keys)
+    _assert_sums_equal(onehot, gather, f"gather K={k}")
+
+
+def test_gather_vs_onehot_xoroshiro():
+    """The sequential-stream rng path threads the same gather flag (its
+    notify is the same code), extending the native bit-compat contract."""
+    cfg = dataclasses.replace(FAST, rng="xoroshiro", runs=8, batch_size=8)
+    eng = Engine(cfg)
+    keys = eng.make_keys(0, 8)
+    _assert_sums_equal(
+        Engine(dataclasses.replace(cfg, **LEGACY)).run_batch(keys),
+        eng.run_batch(keys),
+        "xoroshiro knobs",
+    )
+
+
+def test_gather_program_has_no_onehot_contractions():
+    """The jaxpr pin the CI perf-guard leg mirrors: with the knob on the
+    device-loop program contains dynamic gathers and ZERO one-hot
+    contraction muls over the (R, M, M[, M]) consensus tensors; with the
+    knob off, the legacy muls are present and no gather is traced. The mul
+    shapes are the contraction signatures — selects lower to select_n, so a
+    rank-3/4 int16 mul only ever comes from the one-hot read path."""
+    cfg = dataclasses.replace(EXACT, runs=8, batch_size=8, chunk_steps=64,
+                              count_rebase=False)
+    keys = make_run_keys(cfg.seed, 0, 8)
+
+    def loop_jaxpr(c):
+        eng = Engine(c)
+        hi, lo = eng._ledger_init(8)
+        return str(
+            jax.make_jaxpr(lambda kk: eng._device_loop(kk, hi, lo, eng.params))(keys)
+        )
+
+    on = loop_jaxpr(cfg)
+    off = loop_jaxpr(dataclasses.replace(cfg, consensus_gather=False))
+    contraction = re.compile(r":i16\[8,9,9(,9)?\] = mul")
+    assert not contraction.search(on), "one-hot contraction leaked into gather program"
+    assert " gather[" in on
+    assert len(contraction.findall(off)) >= 4  # cp plane + own_cp/own_in/diag
+    assert " gather[" not in off
+
+
+# ---------------------------------------------------------------------------
+# Count re-basing: round trip across many chunk boundaries, year-long domain.
+
+
+@pytest.mark.parametrize("config", [FAST, EXACT], ids=["fast", "exact-selfish"])
+def test_count_rebase_round_trip_bit_equal(config):
+    """>= 3 chunk boundaries (4 d at chunk_steps=128 is ~18 busy chunks):
+    the re-based int16 run must equal the un-rebased int32 run bit for bit
+    after the final_stats re-add — statistics, counters and moments alike."""
+    assert config.resolved_count_dtype == "int16"
+    keys = make_run_keys(config.seed, 0, config.runs)
+    wide = Engine(dataclasses.replace(
+        config, count_rebase=False, state_dtype="int32")).run_batch(keys)
+    rebased = Engine(config).run_batch(keys)
+    assert int(rebased["tele_chunks_max"]) >= 3
+    _assert_sums_equal(wide, rebased, "count rebase round trip")
+
+
+def test_yearlong_reference_packs_int16_and_matches_int32():
+    """THE acceptance pin of the domain extension: the 365 d reference
+    configs resolve int16 with re-basing on (the un-rebased bound dies at
+    ~106.8 d) and reproduce the int32 un-rebased run bit for bit across
+    ~59 chunk re-bases."""
+    for net, seed in ((default_network(propagation_ms=1000), 3),
+                      (reference_selfish_network(), 5)):
+        year = SimConfig(network=net, runs=2, batch_size=2, seed=seed)
+        assert year.duration_ms >= 365 * 86_400_000
+        assert year.resolved_count_dtype == "int16", year.count_bound
+        assert dataclasses.replace(
+            year, count_rebase=False).resolved_count_dtype == "int32"
+        keys = make_run_keys(seed, 0, 2)
+        rebased = Engine(year).run_batch(keys)
+        wide = Engine(dataclasses.replace(
+            year, count_rebase=False, state_dtype="int32")).run_batch(keys)
+        _assert_sums_equal(wide, rebased, f"year-long {year.resolved_mode}")
+
+
+def test_rebased_flight_rows_stay_absolute():
+    """Flight rows carry absolute chain heights via the recorder's h_base
+    limb (the height twin of the time base limbs): the ring written by a
+    re-based run must be byte-identical to the un-rebased run's."""
+    cfg = dataclasses.replace(EXACT, runs=8, batch_size=8, flight_capacity=512)
+    keys = make_run_keys(cfg.seed, 0, 8)
+    rebased = Engine(cfg).run_batch(keys)
+    plain = Engine(dataclasses.replace(
+        cfg, count_rebase=False, state_dtype="int32")).run_batch(keys)
+    assert int(rebased["tele_chunks_max"]) >= 3
+    np.testing.assert_array_equal(plain["flight_buf"], rebased["flight_buf"])
+    np.testing.assert_array_equal(plain["flight_count"], rebased["flight_count"])
+
+
+def test_dispatch_paths_bit_identical_with_knobs():
+    """device loop == pipelined == host loop == async under gather+rebase —
+    including the pipelined path's overshoot no-op chunks, which re-base
+    again (a second re-base subtracts a refreshed-diagonal delta at most;
+    the final re-add makes it invisible)."""
+    cfg = dataclasses.replace(FAST, runs=16, batch_size=16)
+    eng = Engine(cfg)
+    keys = make_run_keys(cfg.seed, 0, 16)
+    device = eng.run_batch(keys)
+    _assert_sums_equal(device, eng.run_batch(keys, pipelined=True), "pipelined")
+    _assert_sums_equal(device, eng.run_batch(keys, host_loop=True), "host loop")
+    _assert_sums_equal(device, eng.run_batch_async(keys)(), "async")
+
+
+def test_scan_vs_pallas_gather_and_rebase():
+    """The kernel's take_along_axis gather reads and the (outside-kernel)
+    count re-base are pinned bit-equal to the scan engine AND to the
+    kernel's own legacy one-hot path, exact-selfish with the flight ring
+    armed (the densest leaf set)."""
+    from tpusim.pallas_engine import PallasEngine
+
+    cfg = dataclasses.replace(
+        EXACT, runs=128, batch_size=128, duration_ms=2 * 86_400_000,
+        flight_capacity=256,
+    )
+    assert cfg.resolved_count_dtype == "int16"
+    keys = make_run_keys(cfg.seed, 0, 128)
+    scan = Engine(cfg).run_batch(keys)
+    pallas = PallasEngine(
+        cfg, tile_runs=128, step_block=32, interpret=True
+    ).run_batch(keys)
+    _assert_sums_equal(scan, pallas, "scan-vs-pallas knobs on")
+    pallas_legacy = PallasEngine(
+        dataclasses.replace(cfg, **LEGACY),
+        tile_runs=128, step_block=32, interpret=True,
+    ).run_batch(keys)
+    _assert_sums_equal(pallas_legacy, pallas, "pallas gather-vs-onehot")
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint resume across the knobs; config-level contracts.
+
+
+def test_resume_from_rebased_checkpoint(tmp_path):
+    """consensus_gather/count_rebase are NOT sampling identity: a checkpoint
+    written by the re-based gather engine must resume under the full legacy
+    knob set with bit-identical statistics."""
+    from tpusim.runner import run_simulation_config
+
+    ck = tmp_path / "ck.npz"
+    small = dataclasses.replace(FAST, runs=16, batch_size=8, duration_ms=86_400_000)
+    partial = dataclasses.replace(small, runs=8)
+    run_simulation_config(partial, checkpoint_path=ck)  # re-based writer
+    resumed = run_simulation_config(
+        dataclasses.replace(small, **LEGACY), checkpoint_path=ck
+    )
+    direct = run_simulation_config(small)
+    for mr, md in zip(resumed.miners, direct.miners):
+        assert mr.blocks_found_mean == md.blocks_found_mean
+        assert mr.stale_rate_mean == md.stale_rate_mean
+
+
+def test_count_bound_contracts():
+    """TIME_CAP twin, the rebased bound's shape, and the loud int16 error
+    naming both domain maxima."""
+    from tpusim.state import TIME_CAP
+
+    assert TIME_CAP_MS == int(TIME_CAP)
+
+    year = SimConfig(network=reference_selfish_network(), runs=2)
+    plain = dataclasses.replace(year, count_rebase=False)
+    # Re-basing turns the duration bound into a per-chunk one.
+    assert year.count_bound < plain.count_bound
+    assert year.count_bound <= 2**15 - 1 < plain.count_bound
+    # The documented domain edge: ~106.8 d un-rebased at the 600 s interval.
+    # Pinned against the CONSTANT the docs cite, so the two cannot drift
+    # apart (the "~113 d" rot this PR reconciled), and against the literal
+    # so the constant cannot silently move either.
+    assert plain.max_int16_duration_ms() == INT16_MAX_DURATION_MS_600S
+    assert INT16_MAX_DURATION_MS_600S == 9_230_231_273
+    with pytest.raises(ValueError) as ei:
+        dataclasses.replace(plain, state_dtype="int16")
+    assert "106.8 d" in str(ei.value) and "count_rebase" in str(ei.value)
+    # A selfish MAJORITY defeats re-basing (its private lead grows linearly,
+    # so no per-chunk bound exists): auto stays int32, loudly not wrongly.
+    maj = SimConfig(network=default_network(
+        selfish_ids=(0,), hashrates=(60, 10, 10, 10, 5, 3, 1, 1, 0)))
+    assert maj.resolved_count_dtype == "int32"
+    # Serialization round-trips the knobs.
+    rt = SimConfig.from_json(dataclasses.replace(year, **LEGACY).to_json())
+    assert rt.consensus_gather is False and rt.count_rebase is False

@@ -418,6 +418,12 @@ def test_committed_calibration_baseline_is_valid():
     latest = perf.latest_by_scenario(rows)
     assert ("chained_fast", "s_per_chunk") in latest
     assert ("chained_exact", "s_per_chunk") in latest
+    # The year-long int16-rebased domain must stay gated too (the PR-10
+    # scenario: state_dtype pinned "int16" is only legal there because
+    # count_rebase makes the 365 d bound fit).
+    assert ("chained_fast_yearlong", "s_per_chunk") in latest
+    yl = latest[("chained_fast_yearlong", "s_per_chunk")]
+    assert yl["shape"]["state_dtype"] == "int16" and yl["shape"]["count_rebase"]
     for row in latest.values():
         assert row["env"]["platform"] == "cpu"
         assert row["shape"]["runs"] == perf.PROTOCOL["quick"]["runs"]

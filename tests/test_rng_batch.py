@@ -122,17 +122,26 @@ def test_next_words_wide_is_k_sequential_draws():
 
 
 def test_count_dtype_resolution_and_overflow_guard():
-    # Short durations pack; the formula matches engine.default_n_steps.
+    # Short durations pack; without re-basing the bound is the full-duration
+    # event bound, i.e. exactly engine.default_n_steps (the jax-free twin).
     assert FAST.resolved_count_dtype == "int16"
-    assert FAST.count_bound == default_n_steps(
+    plain = dataclasses.replace(FAST, count_rebase=False)
+    assert plain.resolved_count_dtype == "int16"
+    assert plain.count_bound == default_n_steps(
         FAST.duration_ms, FAST.network.block_interval_s
     )
-    # A year-long run cannot fit int16 heights: auto WIDENS...
+    # A year-long run cannot fit int16 heights WITHOUT re-basing: auto
+    # widens, and an explicit int16 request FAILS LOUD instead of wrapping,
+    # naming the max duration of both modes.
     year = dataclasses.replace(FAST, duration_ms=365 * 86_400_000)
-    assert year.resolved_count_dtype == "int32"
-    # ...and an explicit int16 request FAILS LOUD instead of wrapping.
-    with pytest.raises(ValueError, match="int16"):
-        dataclasses.replace(year, state_dtype="int16")
+    year_plain = dataclasses.replace(year, count_rebase=False)
+    assert year_plain.resolved_count_dtype == "int32"
+    with pytest.raises(ValueError, match="count_rebase"):
+        dataclasses.replace(year_plain, state_dtype="int16")
+    # With the default per-chunk count re-basing the bound is per-chunk and
+    # the year-long run packs (the tentpole domain extension; bit-equality
+    # pinned in tests/test_consensus_gather.py).
+    assert year.resolved_count_dtype == "int16"
     # Serialization round-trips both knobs.
     rt = SimConfig.from_json(
         dataclasses.replace(FAST, rng_batch=False, state_dtype="int32").to_json()

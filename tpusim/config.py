@@ -35,6 +35,18 @@ DEFAULT_RUNS = 16 * 2048
 #: 1 s default (ratio 0.0017, error ~3e-6) keeps fast.
 FAST_MODE_MAX_RACE_RATIO = 0.01
 
+#: One chunk's maximum simulated span in ms (tpusim.state.TIME_CAP as a plain
+#: int: this module must stay jax-free, so the value is duplicated here and
+#: pinned equal by tests/test_consensus_gather.py). Under ``count_rebase``
+#: this horizon, not the full duration, sizes the per-chunk count bound.
+TIME_CAP_MS = 2**29
+
+#: The largest ``duration_ms`` whose UN-rebased event bound still fits int16
+#: at the 600 s reference interval — the "~106.8 days" every doc cites
+#: (= _event_bound(d / 600e3) <= 32767 solved for d; recompute with
+#: ``SimConfig.max_int16_duration_ms(count_rebase=False)``).
+INT16_MAX_DURATION_MS_600S = 9_230_231_273
+
 
 @dataclasses.dataclass(frozen=True)
 class MinerConfig:
@@ -196,6 +208,31 @@ class SimConfig:
     #: identical either way (all arithmetic stays in range), so the dtype is
     #: NOT part of the sampling identity or checkpoint fingerprint.
     state_dtype: str = "auto"
+    #: Miner-axis gathers for the consensus sweep (default on): the per-event
+    #: one-hot contract-and-sum reads of the best-chain owner's rows
+    #: (``own_cp[:, b]``, ``own_in[b, :]``, ``cp[b, :, :]`` — O(M^3) MACs to
+    #: read one (M, M) plane) are replaced by dynamic miner-axis indexing on
+    #: the winner index ``_best_chain`` already computes (O(M^2) moves).
+    #: Values are identical — the same entries are read either way — so the
+    #: knob is NOT part of the sampling identity or checkpoint fingerprint;
+    #: False restores the legacy one-hot path for A/B timing and bisection
+    #: (and as the escape hatch if Mosaic's sublane-axis dynamic slice
+    #: lowers poorly on a TPU generation — the next-TPU-window checklist).
+    consensus_gather: bool = True
+    #: Per-chunk count re-basing (default on): extend the ``state.rebase``
+    #: discipline from clocks to the block-COUNT leaves — at each chunk
+    #: boundary the per-owner common base (min blocks of owner o across every
+    #: stored prefix count) is subtracted from ``cp``/``own_*``/``height``
+    #: and accumulated per run in the carried aux exactly like elapsed time,
+    #: then re-added at ``final_stats``. ``count_bound`` then shrinks from a
+    #: duration bound to a per-chunk bound (+ a divergence allowance), so
+    #: ``state_dtype="auto"`` packs int16 for year-long reference runs
+    #: instead of dying at ~106.8 d. Statistics are bit-identical (the
+    #: subtraction is linear and every consensus compare is shift-invariant,
+    #: pinned by tests/test_consensus_gather.py), so the knob is NOT part of
+    #: the sampling identity or checkpoint fingerprint. False restores the
+    #: legacy un-rebased counts for A/B and bisection.
+    count_rebase: bool = True
 
     def __post_init__(self) -> None:
         if self.duration_ms <= 0:
@@ -219,11 +256,21 @@ class SimConfig:
                 f"state_dtype must be auto|int32|int16, got {self.state_dtype!r}"
             )
         if self.state_dtype == "int16" and not self._count_bound_fits_int16:
+            plain = self.max_int16_duration_ms(count_rebase=False)
+            rebased = self.max_int16_duration_ms(count_rebase=True)
+            rebased_s = (
+                "any practical duration"
+                if rebased >= 1 << 50
+                else f"{rebased} (~{rebased / 86_400_000.0:.1f} d)"
+            )
             raise ValueError(
-                f"state_dtype='int16' requested but the per-run event bound "
+                f"state_dtype='int16' requested but the per-run count bound "
                 f"({self.count_bound}) exceeds int16 at duration_ms="
-                f"{self.duration_ms}; use 'auto' (widens to int32) or shorten "
-                f"the duration"
+                f"{self.duration_ms} (count_rebase={self.count_rebase}); the "
+                f"largest duration_ms that fits this roster/interval is "
+                f"{plain} (~{plain / 86_400_000.0:.1f} d) without re-basing "
+                f"and {rebased_s} with count_rebase=True; use 'auto' (widens "
+                f"to int32), enable count_rebase, or shorten the duration"
             )
         # 32-bit time-arithmetic envelope (see tpusim.state docstring): one
         # interval draw must stay far below INTERVAL_CAP = 2^27 ms, and
@@ -255,23 +302,88 @@ class SimConfig:
             return self.group_slots
         return 2
 
-    @property
-    def count_bound(self) -> int:
-        """Upper bound on ANY block-count state value one run can reach: the
-        per-run event-loop bound (found + arrival events at mean + 8 sigma of
-        the Poisson block count, engine.default_n_steps) — every height /
-        group count / consensus-tensor entry is at most the run's total block
-        count, which is at most half this, and the ``stale`` counter's
-        pathological multi-count geometries stay well inside the remaining
-        2x headroom (a popped block can only be re-popped after a
-        re-adoption, a ~race_ratio^2 event per block).
-
-        Same formula as ``engine.default_n_steps`` (kept inline so this
-        module stays jax-free; pinned equal by tests/test_rng_batch.py)."""
+    def _event_bound(self, duration_ms: int) -> int:
+        """Per-run event-loop bound over ``duration_ms``: found + arrival
+        events at mean + 8 sigma of the Poisson block count. Same formula as
+        ``engine.default_n_steps`` (kept inline so this module stays
+        jax-free; pinned equal by tests/test_rng_batch.py)."""
         import math
 
-        mu = self.duration_ms / (self.network.block_interval_s * 1000.0)
+        mu = duration_ms / (self.network.block_interval_s * 1000.0)
         return int(2.0 * (mu + 8.0 * math.sqrt(mu + 1.0))) + 16
+
+    def _divergence_allowance(self) -> int:
+        """Bound on the count residual a per-chunk re-base can leave behind:
+        blocks of one owner above the run's deepest common prefix. Two
+        geometric excursions feed it — a selfish miner's private lead (the
+        p-vs-(1-p) reveal random walk: P(lead >= L) = (p/(1-p))^L per
+        excursion) and propagation-race fork depth (extension probability
+        ~2 x race ratio per block) — each bounded as the supremum over the
+        run's whole event budget with a union-bounded e^-30 tail, the same
+        8-sigma-class exceedance discipline as ``_event_bound``. A
+        supercritical roster (selfish majority, or races that never settle)
+        gets the full event budget back, i.e. re-basing then buys nothing
+        and "auto" stays int32."""
+        import math
+
+        n = self._event_bound(self.duration_ms)
+
+        def geom_sup(q: float) -> int:
+            if q <= 0.0:
+                return 0
+            if q >= 1.0:
+                return n
+            return min(n, int((math.log(2.0 * n) + 30.0) / -math.log(q)) + 1)
+
+        p_selfish = sum(
+            m.hashrate_pct for m in self.network.miners if m.selfish
+        ) / 100.0
+        q_lead = p_selfish / (1.0 - p_selfish) if p_selfish < 0.5 else 1.0
+        q_race = min(1.0, 2.0 * self.max_race_ratio)
+        return geom_sup(q_lead) + geom_sup(q_race)
+
+    @property
+    def count_bound(self) -> int:
+        """Upper bound on ANY block-count state value one run can reach —
+        the quantity the int16 packing decision is made on.
+
+        Without ``count_rebase`` this is the full-duration event bound
+        (``_event_bound``): every height / group count / consensus-tensor
+        entry is at most the run's total block count, which is at most half
+        the event bound, and the ``stale`` counter's pathological
+        multi-count geometries stay well inside the remaining 2x headroom
+        (a popped block can only be re-popped after a re-adoption, a
+        ~race_ratio^2 event per block).
+
+        With ``count_rebase`` the engines subtract the per-owner common
+        base at every chunk boundary, so a stored count is at most the
+        post-re-base residual (``_divergence_allowance``) plus one chunk's
+        growth — the event bound at the TIME_CAP horizon — and the bound
+        stops growing with duration (``stale`` is excluded from packing
+        there and stays int32; it is the one monotone accumulator)."""
+        if self.count_rebase:
+            return (
+                self._event_bound(min(self.duration_ms, TIME_CAP_MS))
+                + self._divergence_allowance()
+            )
+        return self._event_bound(self.duration_ms)
+
+    def max_int16_duration_ms(self, *, count_rebase: bool | None = None) -> int:
+        """The largest ``duration_ms`` whose ``count_bound`` still fits int16
+        for this roster/interval, under the given re-basing mode (default:
+        this config's). The int16 ValueError reports both modes so the fix
+        — enable ``count_rebase`` vs. shorten the run — is in the message."""
+        if count_rebase is None:
+            count_rebase = self.count_rebase
+        probe = dataclasses.replace(
+            self, duration_ms=1, state_dtype="auto", count_rebase=count_rebase
+        )
+        lo, hi = 0, 1 << 50  # ~35M years: de-facto "unbounded" under re-basing
+        while lo < hi:
+            mid = (lo + hi + 1) // 2
+            fits = dataclasses.replace(probe, duration_ms=mid)._count_bound_fits_int16
+            lo, hi = (mid, hi) if fits else (lo, mid - 1)
+        return lo
 
     @property
     def _count_bound_fits_int16(self) -> bool:
@@ -281,8 +393,12 @@ class SimConfig:
     def resolved_count_dtype(self) -> str:
         """The dtype actually compiled for the block-count state leaves:
         ``state_dtype`` unless "auto", which packs to int16 exactly when
-        :attr:`count_bound` fits (~106 days at the 600 s reference interval)
-        and widens to int32 otherwise."""
+        :attr:`count_bound` fits — up to ~106.8 days at the 600 s reference
+        interval without re-basing (:data:`INT16_MAX_DURATION_MS_600S`);
+        with the default ``count_rebase`` the bound is per-chunk and
+        year-long reference runs pack too — and widens to int32 otherwise.
+        ``stale`` is the exception under re-basing: it is the one monotone
+        accumulator, excluded from packing there (it stays int32)."""
         if self.state_dtype != "auto":
             return self.state_dtype
         return "int16" if self._count_bound_fits_int16 else "int32"
@@ -328,6 +444,8 @@ def _config_to_dict(cfg: SimConfig) -> dict[str, Any]:
         "flight_capacity": cfg.flight_capacity,
         "rng_batch": cfg.rng_batch,
         "state_dtype": cfg.state_dtype,
+        "consensus_gather": cfg.consensus_gather,
+        "count_rebase": cfg.count_rebase,
     }
 
 
@@ -362,4 +480,8 @@ def _config_from_dict(d: dict[str, Any]) -> SimConfig:
         kwargs["rng_batch"] = bool(d["rng_batch"])
     if "state_dtype" in d:
         kwargs["state_dtype"] = str(d["state_dtype"])
+    if "consensus_gather" in d:
+        kwargs["consensus_gather"] = bool(d["consensus_gather"])
+    if "count_rebase" in d:
+        kwargs["count_rebase"] = bool(d["count_rebase"])
     return SimConfig(network=network, **kwargs)

@@ -67,6 +67,7 @@ from .state import (
     make_params,
     notify,
     rebase,
+    rebase_counts,
 )
 
 __all__ = [
@@ -89,12 +90,13 @@ _I32_SUM_GUARD = 2**31 - 1
 #: halves a table value until it divides the step budget, so entries can
 #: assume the 64-aligned auto chunk_steps / Pallas step_block.
 AUTO_SUPERSTEP_TABLE: dict[tuple[str, str], int] = {
-    # This container's 2-core CPU, batched-RNG engine (PR 6 ablation,
-    # artifacts/roofline_cpu.json --k-list 1,2,4,8,16): fast mode peaks at
-    # K=2 at the production batches (636k ev/s vs 599k at K=1 at batch 256
-    # int32; K>=4 regresses) — only the small batch-64 cell prefers K=1 —
-    # and exact mode regresses at every K>1 (160k at K=1 vs 127k at K=2,
-    # batch 256; the headline A/B at 512 runs agrees, 8.1 s vs 12+ s).
+    # This container's 2-core CPU, batched-RNG gather engine (PR 10
+    # re-ablation, artifacts/roofline_cpu.json): fast mode keeps K=2 at the
+    # production batches (int16-rebased batch 256: 839k ev/s at K=2 vs 701k
+    # at K=1; K=4's 879k is within round noise of K=2) — only the small
+    # batch-64 cell prefers K=1 — and exact mode still regresses at every
+    # K>1 (int16-rebased batch 256: 323k at K=1 vs 264k at K=2; the
+    # headline A/B at 512 runs agrees).
     ("cpu", "fast"): 2,
     ("cpu", "exact"): 1,
     # v5e round-5 on-chip ablation (artifacts/perf_tpu.jsonl): fast kernel
@@ -298,6 +300,22 @@ def _host_reduce_sums(out: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
     return out
 
 
+def apply_count_rebase(state: SimState, cb, fr, *, batched: bool = False):
+    """The chunk-boundary count re-base, shared by every site that runs it
+    (both scan chunk_fn rng paths per-run, the pallas chunk batched):
+    re-base the count leaves, fold the subtracted per-owner base into the
+    carried ``cb`` accumulator, and advance the flight recorder's absolute
+    height origin by the total. Returns ``(state, cb, fr)``."""
+    rc = jax.vmap(rebase_counts) if batched else rebase_counts
+    state, delta = rc(state)
+    cb = cb + delta
+    if fr is not None:
+        from .flight import advance_height_base
+
+        fr = advance_height_base(fr, jnp.sum(delta, axis=-1, dtype=jnp.int32))
+    return state, cb, fr
+
+
 def default_n_steps(duration_ms: int, block_interval_s: float) -> int:
     """Upper bound on event-loop iterations for one run: found events +
     arrival events <= 2x the block count, sized at mean + 8 sigma of the
@@ -308,7 +326,7 @@ def default_n_steps(duration_ms: int, block_interval_s: float) -> int:
 
 def _step_event(
     state: SimState, w: jax.Array, dt: jax.Array, params: SimParams, cap: jax.Array,
-    any_selfish: bool, fr=None,
+    any_selfish: bool, fr=None, gather: bool = True,
 ):
     """One event given this step's (winner, interval) draws: a block find if
     one is due at ``t``, then the notify sweep, then cut-through time advance.
@@ -327,7 +345,10 @@ def _step_event(
     """
     active = state.t < cap
     found_due = active & (state.t == state.next_block_time)
-    state1 = found_block(state, params, jnp.where(found_due, w, jnp.int32(-1)), any_selfish)
+    state1 = found_block(
+        state, params, jnp.where(found_due, w, jnp.int32(-1)), any_selfish,
+        gather=gather,
+    )
     nbt = jnp.where(found_due, state.t + dt, state.next_block_time)
     state1 = state1._replace(next_block_time=nbt)
 
@@ -336,7 +357,9 @@ def _step_event(
     # published state changes (all stamps are in the future), so deferral is
     # only load-bearing for 0ms-propagation configs.
     do_notify = active & ~(found_due & (nbt == state.t))
-    state2 = notify(state1, params, do=do_notify, any_selfish=any_selfish)
+    state2 = notify(
+        state1, params, do=do_notify, any_selfish=any_selfish, gather=gather
+    )
 
     # Cut-through to the next event (main.cpp:173-182). The max() guard keeps
     # time in place when a same-ms find is still pending (unflushed arrivals
@@ -355,18 +378,18 @@ def _step_event(
 
 def _step(
     state: SimState, bits2: jax.Array, params: SimParams, cap: jax.Array,
-    any_selfish: bool, fr=None,
+    any_selfish: bool, fr=None, gather: bool = True,
 ):
     """Threefry step: one (winner, interval) uint32 word pair is burned per
     scan step whether or not a find is due — that is what makes the draws
     counter-based and order-independent (module docstring)."""
     w = winner_from_bits(bits2[0], params.thresholds)
     dt = interval_from_bits(bits2[1], params.mean_interval_ms)
-    return _step_event(state, w, dt, params, cap, any_selfish, fr=fr)
+    return _step_event(state, w, dt, params, cap, any_selfish, fr=fr, gather=gather)
 
 
 def _step_xoro(state: SimState, xi, xw, params: SimParams, cap: jax.Array,
-               any_selfish: bool, fr=None):
+               any_selfish: bool, fr=None, gather: bool = True):
     """xoroshiro128++ step: two sequential per-run streams (interval, winner)
     advanced ONLY when the draw is consumed (a find is due this step), exactly
     mirroring the native backend's consumption pattern
@@ -387,7 +410,8 @@ def _step_xoro(state: SimState, xi, xw, params: SimParams, cap: jax.Array,
     dt = interval_ms_from_word(ih, il, params.mean_interval_ms, float(INTERVAL_CAP))
     xi = select_streams(found_due, xi2, xi)
     xw = select_streams(found_due, xw2, xw)
-    state2, fr = _step_event(state, w, dt, params, cap, any_selfish, fr=fr)
+    state2, fr = _step_event(state, w, dt, params, cap, any_selfish, fr=fr,
+                             gather=gather)
     return state2, xi, xw, fr
 
 
@@ -488,6 +512,11 @@ class Engine:
 
         self.count_dtype = cdt = COUNT_DTYPES[config.resolved_count_dtype]
         rng_batch = config.rng_batch
+        # Miner-axis gather reads + per-chunk count re-basing: both pure
+        # compile-time knobs, results bit-identical (the A/B twins of
+        # rng_batch — tests/test_consensus_gather.py pins both).
+        gather = config.consensus_gather
+        self.count_rebase = count_rebase = config.count_rebase
         # Flight recorder (tpusim.flight): a trace-time constant. 0 means the
         # recorder leaves are never created and no recording op is traced —
         # the jitted programs are identical to a recorder-less build (pinned
@@ -510,7 +539,7 @@ class Engine:
             )
 
             def init_fn(packed: jax.Array, params: SimParams):
-                state = init_state(m, k, exact, cdt, any_selfish)
+                state = init_state(m, k, exact, cdt, any_selfish, count_rebase)
                 xi, xw = unpack_run_streams(packed)
                 # Initial next-block draw from the interval stream, like the
                 # native loop's pre-loop draw (simcore simulate_run).
@@ -518,17 +547,21 @@ class Engine:
                 nbt = interval_ms_from_word(
                     ih, il, params.mean_interval_ms, float(INTERVAL_CAP)
                 )
-                # The recorder slot is always present; None is an empty
-                # pytree, so the fcap=0 aux (and every program carrying it)
-                # is unchanged by the uniform arity.
+                # The recorder and count-base slots are always present; None
+                # is an empty pytree, so the fcap=0 / un-rebased aux (and
+                # every program carrying it) is unchanged by the uniform
+                # arity.
                 fr = _flight.init_recorder(fcap) if fcap else None
-                return state._replace(next_block_time=nbt), (init_counters(m), xi, xw, fr)
+                cb = jnp.zeros((m,), jnp.int32) if count_rebase else None
+                return state._replace(next_block_time=nbt), (
+                    init_counters(m), xi, xw, fr, cb,
+                )
 
             def chunk_fn(
                 state: SimState, aux, cap: jax.Array, run_key: jax.Array,
                 chunk_idx: jax.Array, params: SimParams,
             ):
-                ctr, xi, xw, fr = aux
+                ctr, xi, xw, fr, cb = aux
 
                 def body_wide(carry, _):
                     # Batched wide generation (rng_batch): pre-advance both
@@ -558,7 +591,8 @@ class Engine:
                         w = jnp.sum(jnp.where(sel, w_cand, 0), dtype=jnp.int32)
                         dt = jnp.sum(jnp.where(sel, dt_cand, 0), dtype=jnp.int32)
                         st, fr = _step_event(
-                            st, w, dt, params, cap, any_selfish, fr=fr
+                            st, w, dt, params, cap, any_selfish, fr=fr,
+                            gather=gather,
                         )
                         consumed = consumed + found_due.astype(jnp.int32)
                         ctr = _count_step(ctr, prev, st, cap)
@@ -571,7 +605,8 @@ class Engine:
                     for _j in range(K):
                         prev = st
                         st, xi, xw, fr = _step_xoro(
-                            st, xi, xw, params, cap, any_selfish, fr
+                            st, xi, xw, params, cap, any_selfish, fr,
+                            gather=gather,
                         )
                         ctr = _count_step(ctr, prev, st, cap)
                     return (st, xi, xw, ctr, fr), None
@@ -583,24 +618,28 @@ class Engine:
                 state, elapsed = rebase(state)
                 if fr is not None:
                     fr = _flight.advance_base(fr, elapsed)
-                return state, (ctr, xi, xw, fr), elapsed
+                if count_rebase:
+                    state, cb, fr = apply_count_rebase(state, cb, fr)
+                return state, (ctr, xi, xw, fr, cb), elapsed
         else:
             from .sampling import winners_from_bits
 
             def init_fn(run_key: jax.Array, params: SimParams):
-                state = init_state(m, k, exact, cdt, any_selfish)
+                state = init_state(m, k, exact, cdt, any_selfish, count_rebase)
                 bits = jax.random.bits(jax.random.fold_in(run_key, 0), (2,), jnp.uint32)
-                # None recorder slot = empty pytree: see the xoroshiro twin.
+                # None recorder/count-base slots = empty pytree leaves: see
+                # the xoroshiro twin.
                 fr = _flight.init_recorder(fcap) if fcap else None
+                cb = jnp.zeros((m,), jnp.int32) if count_rebase else None
                 return state._replace(
                     next_block_time=interval_from_bits(bits[1], params.mean_interval_ms)
-                ), (init_counters(m), fr)
+                ), (init_counters(m), fr, cb)
 
             def chunk_fn(
                 state: SimState, aux, cap: jax.Array, run_key: jax.Array,
                 chunk_idx: jax.Array, params: SimParams,
             ):
-                ctr, fr = aux
+                ctr, fr, cb = aux
                 key = jax.random.fold_in(run_key, 1 + chunk_idx)
                 # The (steps, 2) word block reshaped to (steps/K, K, ...):
                 # scan step s row j is word pair s*K + j — the same per-event
@@ -628,7 +667,8 @@ class Engine:
                         for j in range(K):
                             prev = st
                             st, fr = _step_event(
-                                st, wk[j], dtk[j], params, cap, any_selfish, fr=fr
+                                st, wk[j], dtk[j], params, cap, any_selfish,
+                                fr=fr, gather=gather,
                             )
                             ctr = _count_step(ctr, prev, st, cap)
                         return (st, ctr, fr), None
@@ -640,7 +680,8 @@ class Engine:
                         st, ctr, fr = carry
                         for j in range(K):
                             prev = st
-                            st, fr = _step(st, x[j], params, cap, any_selfish, fr)
+                            st, fr = _step(st, x[j], params, cap, any_selfish,
+                                           fr, gather=gather)
                             ctr = _count_step(ctr, prev, st, cap)
                         return (st, ctr, fr), None
 
@@ -648,10 +689,18 @@ class Engine:
                 state, elapsed = rebase(state)
                 if fr is not None:
                     fr = _flight.advance_base(fr, elapsed)
-                return state, (ctr, fr), elapsed
+                if count_rebase:
+                    state, cb, fr = apply_count_rebase(state, cb, fr)
+                return state, (ctr, fr, cb), elapsed
 
-        def finalize_fn(state: SimState, t_end: jax.Array) -> dict[str, jax.Array]:
-            per_run = jax.vmap(final_stats)(state, t_end)
+        def finalize_fn(
+            state: SimState, t_end: jax.Array, cbase=None
+        ) -> dict[str, jax.Array]:
+            # ``cbase`` is the aux's accumulated per-run count base (int32
+            # [R, M] under count_rebase, None otherwise): final_stats is the
+            # re-add boundary where the re-based counts become absolute
+            # again, so every output below is bit-identical either way.
+            per_run = jax.vmap(final_stats)(state, t_end, cbase)
             return {
                 "blocks_found_sum": jnp.sum(per_run["blocks_found"], axis=0),
                 "stale_blocks_sum": jnp.sum(per_run["stale_blocks"], axis=0),
@@ -728,8 +777,8 @@ class Engine:
                     blocks_found_per_run=P("runs"),
                 )
 
-            def sharded_finalize(state, t_end):
-                local = finalize_fn(state, t_end)
+            def sharded_finalize(state, t_end, cbase):
+                local = finalize_fn(state, t_end, cbase)
                 share = local.pop("blocks_share_per_run")
                 stale = local.pop("stale_rate_per_run")
                 found = local.pop("blocks_found_per_run")
@@ -750,7 +799,8 @@ class Engine:
             self._finalize = jax.jit(
                 shard_map(
                     sharded_finalize, mesh=mesh,
-                    in_specs=(P("runs"), P("runs")), out_specs=out_specs,
+                    in_specs=(P("runs"), P("runs"), P("runs")),
+                    out_specs=out_specs,
                     check_vma=False,
                 )
             )
@@ -843,7 +893,8 @@ class Engine:
             type(self).__name__, self.n_miners, c.resolved_group_slots,
             self.exact, self.any_selfish, self.chunk_steps, self.superstep,
             self.max_chunks, c.rng, c.flight_capacity, c.rng_batch,
-            c.resolved_count_dtype, mesh_id,
+            c.resolved_count_dtype, c.consensus_gather, c.count_rebase,
+            mesh_id,
         )
 
     def rebind(self, config: SimConfig, key: tuple) -> "Engine":
@@ -934,7 +985,7 @@ class Engine:
         i, state, aux, hi, lo = jax.lax.while_loop(
             cond, body, (jnp.int32(0), state, aux, hi0, lo0)
         )
-        sums = self._finalize_impl(state, hi * base + lo)
+        sums = self._finalize_impl(state, hi * base + lo, aux[-1])
         # Per-run telemetry counters out of the carried aux; reduced on the
         # host like the ratio leaves (_host_reduce_telemetry) — an int32
         # device sum of active_steps would overflow on large batches.
@@ -946,7 +997,10 @@ class Engine:
     def _aux_to_sums(self, aux, sums: dict) -> None:
         """Spill the carried aux (counters and, when recording, the flight
         ring) into per-run output leaves — the one place the aux layout is
-        decoded, shared by all three dispatch paths."""
+        decoded, shared by all three dispatch paths. The aux tuple always
+        ends (..., fr, cb): recorder slot then accumulated count base, each
+        None (an empty pytree leaf) when its feature is off; ``cb`` is
+        consumed by finalize's re-add, not exported."""
         ctr: SimCounters = aux[0]
         sums["tele_reorg_depth_per_run"] = ctr.reorg_max
         sums["tele_stale_events_per_run"] = ctr.stale_events
@@ -954,7 +1008,7 @@ class Engine:
         sums["tele_stale_by_miner_per_run"] = ctr.stale_by_miner
         sums["tele_reorg_depth_hist_per_run"] = ctr.reorg_depth_hist
         if self.flight_capacity:
-            fr = aux[-1]
+            fr = aux[-2]
             sums["flight_buf"] = fr.buf
             sums["flight_count"] = fr.count
 
@@ -1025,7 +1079,7 @@ class Engine:
                 f"{self.chunk_steps} steps — event count beyond the Poisson bound"
             )
         t_end = hi * jnp.int32(self._LEDGER_BASE) + lo
-        sums = self._finalize(state, t_end)
+        sums = self._finalize(state, t_end, aux[-1])
         # tpusim-lint: disable=JX002 -- batch-end stat transfer, once per
         # batch, after the dispatch loop has fully drained.
         out = _host_reduce_sums({k: np.asarray(v) for k, v in sums.items()})
@@ -1225,7 +1279,7 @@ class Engine:
             )
 
         t_end = device_i32(remaining)
-        sums = self._finalize(state, t_end)
+        sums = self._finalize(state, t_end, aux[-1])
         # tpusim-lint: disable=JX002 -- batch-end stat transfer (see
         # _run_batch_pipelined); the loop above has already terminated.
         out = _host_reduce_sums({k: np.asarray(v) for k, v in sums.items()})

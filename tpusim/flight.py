@@ -61,6 +61,7 @@ from .state import INF_TIME, SimState
 
 __all__ = [
     "FlightRecorder", "init_recorder", "record_step", "advance_base",
+    "advance_height_base",
     "KIND_FIND", "KIND_ARRIVAL", "KIND_STALE", "KIND_REORG", "KIND_NAMES",
     "N_FIELDS", "FLIGHT_TIME_BASE",
 ]
@@ -96,11 +97,19 @@ class FlightRecorder(NamedTuple):
     base_hi: jax.Array
     #: int32 [] low limb (< 2^30).
     base_lo: jax.Array
+    #: int32 [] absolute HEIGHT of the current chunk origin: the accumulated
+    #: per-chunk count-re-base total (sum over owners of the subtracted base,
+    #: tpusim.state.rebase_counts). Rows store base + stored-height so the
+    #: exported trace always carries absolute chain heights, exactly like
+    #: the time limbs carry absolute milliseconds; stays 0 (and the adds are
+    #: no-ops) when SimConfig.count_rebase is off. One int32 limb suffices —
+    #: heights fit int32 for any run the block-count sum guard admits.
+    h_base: jax.Array
 
 
 def init_recorder(capacity: int) -> FlightRecorder:
     z = jnp.zeros((), I32)
-    return FlightRecorder(jnp.zeros((capacity, N_FIELDS), I32), z, z, z)
+    return FlightRecorder(jnp.zeros((capacity, N_FIELDS), I32), z, z, z, z)
 
 
 def _push_row(
@@ -158,7 +167,7 @@ def record_step(
     kind1 = jnp.where(found_due, KIND_FIND, KIND_ARRIVAL)
     miner1 = jnp.where(found_due, w, arr_miner)
     h_src = jnp.where(found_due, found.height, new.height)
-    height1 = jnp.sum(jnp.where(midx == miner1, h_src, 0), dtype=I32)
+    height1 = jnp.sum(jnp.where(midx == miner1, h_src, 0), dtype=I32) + fr.h_base
     fr = _push_row(fr, rec1, kind1, miner1, height1, jnp.int32(0), t)
 
     # Row 2 — the sweep's adoption outcome. Adoption is the only height
@@ -172,7 +181,7 @@ def record_step(
     kind2 = jnp.where(dmax > 0, KIND_STALE, KIND_REORG)
     score = jnp.where(adopt, d_stale, -1)
     miner2 = jnp.min(jnp.where(adopt & (score == jnp.max(score)), midx, m))
-    height2 = jnp.sum(jnp.where(midx == miner2, new.height, 0), dtype=I32)
+    height2 = jnp.sum(jnp.where(midx == miner2, new.height, 0), dtype=I32) + fr.h_base
     return _push_row(fr, rec2, kind2, miner2, height2, dmax, t)
 
 
@@ -185,3 +194,10 @@ def advance_base(fr: FlightRecorder, elapsed: jax.Array) -> FlightRecorder:
         base_hi=fr.base_hi + carry.astype(I32),
         base_lo=lo - jnp.where(carry, jnp.int32(FLIGHT_TIME_BASE), 0),
     )
+
+
+def advance_height_base(fr: FlightRecorder, dh: jax.Array) -> FlightRecorder:
+    """Advance the absolute height origin by a count re-base's total
+    subtracted base (``sum(rebase_counts base)``) — the height twin of
+    :func:`advance_base`, called at the same chunk boundary."""
+    return fr._replace(h_base=fr.h_base + dh.astype(I32))

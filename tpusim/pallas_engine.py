@@ -42,7 +42,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .config import SimConfig
-from .engine import DEPTH_BUCKETS, Engine, SimCounters, combine_sums
+from .engine import (
+    DEPTH_BUCKETS,
+    Engine,
+    SimCounters,
+    apply_count_rebase,
+    combine_sums,
+)
 from .flight import (
     KIND_ARRIVAL,
     KIND_FIND,
@@ -103,9 +109,11 @@ _TELE_LEAVES = ("mre", "sev", "act", "sbm", "rdh")
 
 #: Flight-recorder leaves (tpusim.flight), appended after the telemetry
 #: leaves when ``SimConfig.flight_capacity > 0``: the packed event ring
-#: (capacity, N_FIELDS, R), the event count (1, R) and the absolute-time
-#: chunk-origin limb pair (2, R). With the default capacity 0 they do not
-#: exist and the kernel is byte-identical to a recorder-less build.
+#: (capacity, N_FIELDS, R), the event count (1, R) and the chunk-origin
+#: limbs (3, R) — absolute time as the base-2^30 [hi, lo] pair plus the
+#: absolute-height base h_base (FlightRecorder.h_base, the count-re-base
+#: accumulator). With the default capacity 0 they do not exist and the
+#: kernel is byte-identical to a recorder-less build.
 _FLIGHT_LEAVES = ("fbuf", "fcnt", "fbase")
 
 
@@ -126,18 +134,23 @@ _COUNT_LEAVES = frozenset(
 )
 
 
-def _leaf_dtypes(m: int, k: int, exact: bool, count_dtype) -> list:
+def _leaf_dtypes(m: int, k: int, exact: bool, count_dtype,
+                 count_rebase: bool = False) -> list:
     """Per-leaf dtypes parallel to :func:`_leaf_shapes` — the packed-state
     authority shared by the kernel's out_shape list and the roofline traffic
-    model (profiling.state_bytes_per_run)."""
+    model (profiling.state_bytes_per_run). Under ``count_rebase`` the
+    ``stale`` leaf stays int32: it is the one monotone accumulator the
+    chunk-boundary re-base does not shift (tpusim.state.rebase_counts), so
+    its packed bound would still be the full-duration one."""
     names = _EXACT_LEAVES if exact else _FAST_LEAVES
-    return [count_dtype if n in _COUNT_LEAVES else I32 for n in names]
+    counts = _COUNT_LEAVES - {"stale"} if count_rebase else _COUNT_LEAVES
+    return [count_dtype if n in counts else I32 for n in names]
 
 
 def _make_kernel(
     *, exact: bool, any_selfish: bool, sb: int, mean_interval_ms: float,
     n_state: int, superstep: int = 1, flight_capacity: int = 0,
-    rng_batch: bool = True, count_dtype=I32
+    rng_batch: bool = True, count_dtype=I32, gather: bool = True
 ):
     """Build the step-block kernel for one mode. Ref order: bits, cap, lo,
     hi, prop, selfish, then ``n_state`` input state refs (HBM-aliased to the
@@ -154,7 +167,17 @@ def _make_kernel(
     one-hot compare. False streams the raw uint32 threefry words and maps
     them per event (the legacy path). ``count_dtype`` (int16 when the
     packed-state bound fits) types every _COUNT_LEAVES ref and all count
-    arithmetic; values are identical, the VMEM residency halves."""
+    arithmetic; values are identical, the VMEM residency halves.
+
+    ``gather`` (SimConfig.consensus_gather): the sweep's b-indexed reads —
+    ``own_cnt[b]``, ``own_cp[:, b]``, ``own_in[b, :]`` and the ``cp[b]``
+    plane — use per-lane ``take_along_axis`` on the winner index the
+    best-chain min already computes, instead of multiplying the whole
+    tensor by the one-hot and reducing (O(M^3 x R) MACs for the cp plane
+    become O(M^2 x R) moves). Same entries, bit-identical values; the
+    legacy contractions stay behind the knob for A/B and because a
+    sublane-axis dynamic gather is exactly the op class Mosaic may lower
+    poorly on some TPU generations (next-TPU-window checklist)."""
     fcap = flight_capacity
     cdt = count_dtype
 
@@ -364,10 +387,22 @@ def _make_kernel(
             tipm = jnp.where(cand, base, inf)
             best_tip = jnp.min(tipm, axis=0, keepdims=True)
             winners_b = cand & (tipm == best_tip)
-            # First true along the miner axis without a cumsum.
+            # First true along the miner axis without a cumsum. Always < m
+            # (>= 1 candidate exists) — the per-lane index the gather path
+            # reads rows with.
             first_idx = jnp.min(jnp.where(winners_b, midx, m), axis=0, keepdims=True)
             onehot_b = midx == first_idx  # (M, R)
             b32 = onehot_b.astype(cdt)
+
+            def takeb(arr, axis):
+                """arr[..., b, ...] along ``axis`` for the per-lane winner
+                index (1, R): one gather per lane instead of a whole-tensor
+                one-hot contract-and-sum. Keeps a size-1 ``axis`` dim."""
+                tgt = arr.shape[:axis] + (1,) + arr.shape[axis + 1:]
+                idx = jnp.broadcast_to(
+                    first_idx.reshape((1,) * (arr.ndim - 1) + (-1,)), tgt
+                )
+                return jnp.take_along_axis(arr, idx, axis=axis)
 
             if exact and any_selfish:
                 # --- Selfish reveal (simulation.h:149-174), before reorg.
@@ -389,27 +424,41 @@ def _make_kernel(
             # --- Reorg (simulation.h:124-142): adopt when strictly longer
             # than the full local chain (private blocks included).
             adopt = (best_h > height) & do  # (M, R)
-            unpub_b = jnp.sum(height * b32, axis=0, keepdims=True, dtype=cdt) - best_h  # (1, R)
 
             # Shared diagonal corrections (tpusim.state.notify): ocnt is the
-            # authority for every stale diagonal read.
+            # authority for every stale diagonal read. Gather path reads b's
+            # rows by the per-lane index; legacy contracts with the one-hot.
             ocp, oin = st["ocp"], st["oin"]
-            cnt_b = jnp.sum(ocnt * b32, axis=0, keepdims=True, dtype=cdt)  # (1, R)
-            if exact:
-                # Exact ocp is stored transposed ([j, i], see _EXACT_LEAVES);
-                # own_cp[:, b] is its b-th plane.
-                oc_b = jnp.sum(ocp * b32[:, None, :], axis=0, dtype=cdt)  # (M, R)
+            if gather:
+                unpub_b = takeb(height, 0) - best_h  # (1, R)
+                cnt_b = takeb(ocnt, 0)  # (1, R)
+                if exact:
+                    # Exact ocp is stored transposed ([j, i], see
+                    # _EXACT_LEAVES); own_cp[:, b] is its b-th plane.
+                    oc_b = takeb(ocp, 0)[0]  # (M, R)
+                else:
+                    oc_b = takeb(ocp, 1)[:, 0, :]  # (M, R) own_cp[:, b]
+                oc_bb = takeb(oc_b, 0)
             else:
-                oc_b = jnp.sum(ocp * b32[None, :, :], axis=1, dtype=cdt)  # (M, R) own_cp[:, b]
-            oc_bb = jnp.sum(oc_b * b32, axis=0, keepdims=True, dtype=cdt)
+                unpub_b = jnp.sum(height * b32, axis=0, keepdims=True, dtype=cdt) - best_h  # (1, R)
+                cnt_b = jnp.sum(ocnt * b32, axis=0, keepdims=True, dtype=cdt)  # (1, R)
+                if exact:
+                    oc_b = jnp.sum(ocp * b32[:, None, :], axis=0, dtype=cdt)  # (M, R)
+                else:
+                    oc_b = jnp.sum(ocp * b32[None, :, :], axis=1, dtype=cdt)  # (M, R) own_cp[:, b]
+                oc_bb = jnp.sum(oc_b * b32, axis=0, keepdims=True, dtype=cdt)
             oc_b = oc_b + b32 * (cnt_b - oc_bb)
             # Own blocks above lca(:, b) — reorg stale accounting. The
             # per-miner pop count also feeds the telemetry counters below,
             # exactly like the scan engine's stale delta (engine._count_step).
             d_stale = jnp.where(adopt, ocnt - oc_b, 0)
             stale = stale + d_stale
-            row_b = jnp.sum(oin * b32[:, None, :], axis=0, dtype=cdt)  # (M, R) own_in[b, :]
-            row_bb = jnp.sum(row_b * b32, axis=0, keepdims=True, dtype=cdt)
+            if gather:
+                row_b = takeb(oin, 0)[0]  # (M, R) own_in[b, :]
+                row_bb = takeb(row_b, 0)
+            else:
+                row_b = jnp.sum(oin * b32[:, None, :], axis=0, dtype=cdt)  # (M, R) own_in[b, :]
+                row_bb = jnp.sum(row_b * b32, axis=0, keepdims=True, dtype=cdt)
             row_b = row_b + b32 * (cnt_b - row_bb)
             row_bpub = row_b - unpub_b * b32  # (M, R) composition of b_pub
 
@@ -418,8 +467,16 @@ def _make_kernel(
                 # i == j plane of the stored tensor) but every consumer
                 # below excludes it via ~onehot_b masks, so it needs no
                 # correction (tpusim.state.notify).
-                cpb = jnp.sum(cp * b32[:, None, None, :], axis=0, dtype=cdt)  # (M, M, R)
-                cpb_diag = jnp.sum(jnp.where(eye3, cpb, 0), axis=1, dtype=cdt)  # (M, R) cp[b, i, i]
+                if gather:
+                    cpb = takeb(cp, 0)[0]  # (M, M, R) — one plane move,
+                    # where the one-hot path contracted the whole
+                    # (M, M, M, R) tensor (the single hottest exact-mode op)
+                    cpb_diag = jnp.take_along_axis(
+                        cpb, iot((m, 1, 1), 0), axis=1
+                    )[:, 0, :]  # (M, R) cp[b, i, i] — static diagonal gather
+                else:
+                    cpb = jnp.sum(cp * b32[:, None, None, :], axis=0, dtype=cdt)  # (M, M, R)
+                    cpb_diag = jnp.sum(jnp.where(eye3, cpb, 0), axis=1, dtype=cdt)  # (M, R) cp[b, i, i]
                 # Factored closed-form update (tpusim.state.notify — entry-
                 # for-entry equal to the historical 3-level case analysis):
                 #   Y[j] = (a_j | b_j) ? b_pub : cpb[j]
@@ -495,6 +552,9 @@ def _make_kernel(
                 # engine's recorder, so the buffers are pinned bit-equal.
                 fbuf, fcnt, fbase = st["fbuf"], st["fcnt"], st["fbase"]
                 b_hi, b_lo = fbase[0:1, :], fbase[1:2, :]
+                # Absolute-height origin (flight.FlightRecorder.h_base):
+                # int32 promotion makes the add exact for packed heights.
+                h_b = fbase[2:3, :]
                 cidx = iot((fcap, 1, 1), 0)
                 fidx = iot((1, N_FIELDS, 1), 1)
 
@@ -543,9 +603,9 @@ def _make_kernel(
                     axis=0, keepdims=True,
                 )
                 h2 = jnp.sum(jnp.where(midx == miner2, height, 0), axis=0, keepdims=True)
-                fcnt, fbuf = kpush(fcnt, fbuf, rec1, kind1, miner1, h1,
+                fcnt, fbuf = kpush(fcnt, fbuf, rec1, kind1, miner1, h1 + h_b,
                                    jnp.zeros_like(dmax))
-                fcnt, fbuf = kpush(fcnt, fbuf, rec2, kind2, miner2, h2, dmax)
+                fcnt, fbuf = kpush(fcnt, fbuf, rec2, kind2, miner2, h2 + h_b, dmax)
                 st.update(fbuf=fbuf, fcnt=fcnt)
 
             st.update(
@@ -681,10 +741,14 @@ class PallasEngine(Engine):
 
         cdt = COUNT_DTYPES[config.resolved_count_dtype]
         # dtype-aware state footprint: packed int16 count leaves halve their
-        # VMEM residency (the whole point of SimConfig.state_dtype).
+        # VMEM residency (the whole point of SimConfig.state_dtype; under
+        # count_rebase the stale leaf stays int32 — _leaf_dtypes).
         state_bytes = sum(
             math.prod(s) * jnp.dtype(d).itemsize
-            for s, d in zip(_leaf_shapes(m, k, exact), _leaf_dtypes(m, k, exact, cdt))
+            for s, d in zip(
+                _leaf_shapes(m, k, exact),
+                _leaf_dtypes(m, k, exact, cdt, config.count_rebase),
+            )
         )
         vmem_est = state_bytes * tile_runs * 10
         # The flight ring is VMEM-resident storage plus one (C, F, tile) row
@@ -935,18 +999,19 @@ class PallasEngine(Engine):
                    jnp.moveaxis(ctr.reorg_depth_hist, 0, -1))
         cdt = self.count_dtype
         shapes = [s + (n,) for s in _leaf_shapes(m, k, self.exact)]
-        dtypes = list(_leaf_dtypes(m, k, self.exact, cdt))
+        dtypes = list(_leaf_dtypes(m, k, self.exact, cdt, self.count_rebase))
         shapes += [(1, n)] * 3 + [(m, n), (DEPTH_BUCKETS, n)]
         dtypes += [I32] * len(_TELE_LEAVES)
         fcap = self.flight_capacity
         if fcap:
             # Flight-recorder leaves (tpusim.flight): ring, count, and the
-            # absolute-time chunk-origin limb pair (read-only in-kernel; the
-            # post-rebase advance below is the writer).
-            fr: FlightRecorder = aux[-1]
+            # chunk-origin limbs — absolute time as a base-2^30 pair plus
+            # the absolute-height base (read-only in-kernel; the
+            # post-rebase advances below are the writers).
+            fr: FlightRecorder = aux[-2]
             st = st + (jnp.moveaxis(fr.buf, 0, -1), fr.count[None, :],
-                       jnp.stack([fr.base_hi, fr.base_lo]))
-            shapes += [(fcap, N_FIELDS, n), (1, n), (2, n)]
+                       jnp.stack([fr.base_hi, fr.base_lo, fr.h_base]))
+            shapes += [(fcap, N_FIELDS, n), (1, n), (3, n)]
             dtypes += [I32] * len(_FLIGHT_LEAVES)
 
         def tile_spec(shape):
@@ -969,7 +1034,7 @@ class PallasEngine(Engine):
             mean_interval_ms=float(self.params.mean_interval_ms),
             n_state=len(shapes), superstep=self.superstep,
             flight_capacity=fcap, rng_batch=self.config.rng_batch,
-            count_dtype=cdt,
+            count_dtype=cdt, gather=self.config.consensus_gather,
         )
         grid = (n // tile, steps // sb)
         out = pl.pallas_call(
@@ -1003,8 +1068,17 @@ class PallasEngine(Engine):
             new_fr = advance_base(
                 FlightRecorder(
                     buf=jnp.moveaxis(fb, -1, 0), count=fc[0],
-                    base_hi=fbase[0], base_lo=fbase[1],
+                    base_hi=fbase[0], base_lo=fbase[1], h_base=fbase[2],
                 ),
                 elapsed,
             )
-        return new_state, (new_ctr, new_fr), elapsed
+        new_cb = aux[-1]
+        if self.count_rebase:
+            # Count re-base outside the kernel, in plain XLA — the kernel
+            # never sees it (engine.apply_count_rebase wraps
+            # tpusim.state.rebase_counts; the scan twin runs the identical
+            # code, so the two engines stay bit-equal by construction).
+            new_state, new_cb, new_fr = apply_count_rebase(
+                new_state, new_cb, new_fr, batched=True
+            )
+        return new_state, (new_ctr, new_fr, new_cb), elapsed

@@ -204,17 +204,21 @@ def run_protocol(
     *,
     quick: bool = False,
     engine: str = "auto",
-    scenarios: tuple[str, ...] = ("fast", "exact"),
+    scenarios: tuple[str, ...] = ("fast", "exact", "fast_yearlong"),
     runs: int | None = None,
     n_chunks: int | None = None,
     repeats: int | None = None,
     chunk_steps: int | None = None,
 ) -> list[dict]:
     """Execute the canonical chained-chunk protocol and return ledger rows
-    (one per scenario), every repeat sample recorded. The scenarios are the
-    two headline configs every CHANGES.md perf claim uses: ``fast`` (9-miner
-    2025 roster, 1 s propagation, honest) and ``exact`` (the reference's
-    40 % selfish gamma=0 benchmark)."""
+    (one per scenario), every repeat sample recorded. ``fast`` (9-miner 2025
+    roster, 1 s propagation, honest) and ``exact`` (the reference's 40 %
+    selfish gamma=0 benchmark) pin the int32 un-rebased program these
+    scenarios have always measured at the 365 d headline duration;
+    ``fast_yearlong`` pins the year-long int16-REBASED domain — the
+    production default since the count_rebase knob landed, and a
+    combination only re-basing makes legal past ~106.8 d — so the ledger
+    tracks both programs even as defaults change."""
     from .config import (
         DEFAULT_DURATION_MS,
         SimConfig,
@@ -231,8 +235,21 @@ def run_protocol(
             p[name] = override
 
     nets = {
-        "fast": lambda: default_network(propagation_ms=1000),
-        "exact": reference_selfish_network,
+        # fast/exact pin the program shape they have ALWAYS measured at the
+        # 365 d headline duration — int32 counts, no re-basing (the pre-knob
+        # default, now explicit so the trajectory stays one program).
+        "fast": (
+            lambda: default_network(propagation_ms=1000),
+            {"state_dtype": "int32", "count_rebase": False},
+        ),
+        "exact": (
+            reference_selfish_network,
+            {"state_dtype": "int32", "count_rebase": False},
+        ),
+        "fast_yearlong": (
+            lambda: default_network(propagation_ms=1000),
+            {"state_dtype": "int16", "count_rebase": True},
+        ),
     }
     unknown = [s for s in scenarios if s not in nets]
     if unknown:
@@ -240,10 +257,11 @@ def run_protocol(
 
     rows = []
     for name in scenarios:
+        net_fn, overrides = nets[name]
         cfg = SimConfig(
-            network=nets[name](), duration_ms=DEFAULT_DURATION_MS,
+            network=net_fn(), duration_ms=DEFAULT_DURATION_MS,
             runs=p["runs"], batch_size=p["runs"], seed=7,
-            chunk_steps=p["chunk_steps"],
+            chunk_steps=p["chunk_steps"], **overrides,
         )
         if engine == "scan":
             from .engine import Engine
@@ -268,6 +286,8 @@ def run_protocol(
             "mode": cfg.resolved_mode,
             "rng_batch": cfg.rng_batch,
             "state_dtype": cfg.resolved_count_dtype,
+            "consensus_gather": cfg.consensus_gather,
+            "count_rebase": cfg.count_rebase,
         }
         rows.append(perf_row(
             f"chained_{name}", "s_per_chunk", timing["s_per_chunk"],
@@ -449,8 +469,8 @@ def main(argv: list[str] | None = None) -> int:
                             "min-of-3) instead of the full evidence shape "
                             "(512 runs, 12 chunks, min-of-5)")
     p_run.add_argument("--engine", choices=("auto", "scan", "pallas"), default="auto")
-    p_run.add_argument("--scenarios", default="fast,exact",
-                       help="comma-separated subset of fast,exact")
+    p_run.add_argument("--scenarios", default="fast,exact,fast_yearlong",
+                       help="comma-separated subset of fast,exact,fast_yearlong")
     p_run.add_argument("--runs", type=int)
     p_run.add_argument("--n-chunks", type=int)
     p_run.add_argument("--repeats", type=int)

@@ -184,7 +184,10 @@ def state_bytes_per_run(engine) -> int:
         _math.prod(s) * _jnp.dtype(d).itemsize
         for s, d in zip(
             _leaf_shapes(m, k, engine.exact),
-            _leaf_dtypes(m, k, engine.exact, cdt),
+            # Under count_rebase the stale leaf stays int32 (the one
+            # monotone accumulator the re-base does not shift) — the
+            # traffic model must price the layout actually compiled.
+            _leaf_dtypes(m, k, engine.exact, cdt, engine.config.count_rebase),
         )
     )
 
@@ -262,6 +265,8 @@ def roofline_point(
         "mode": engine.config.resolved_mode,
         "state_dtype": engine.config.resolved_count_dtype,
         "rng_batch": engine.config.rng_batch,
+        "consensus_gather": engine.config.consensus_gather,
+        "count_rebase": engine.config.count_rebase,
         "traffic_model": kind,
         "state_bytes_per_run": model["state_bytes_per_run"],
         "bytes_per_event": round(per_event, 2),

@@ -393,6 +393,13 @@ def run_simulation_config(
         # across versions from before the knobs existed.
         fp_dict.pop("rng_batch", None)
         fp_dict.pop("state_dtype", None)
+        # Same contract for the miner-axis gather reads and per-chunk count
+        # re-basing (pinned by tests/test_consensus_gather.py): statistics
+        # are bit-identical with either knob in either position, so a
+        # checkpoint written re-based resumes un-rebased (and vice versa),
+        # and pre-knob checkpoints keep resuming.
+        fp_dict.pop("consensus_gather", None)
+        fp_dict.pop("count_rebase", None)
         # The default generator is omitted so checkpoints from before the rng
         # field existed (identical threefry draws) still resume; non-default
         # generators fingerprint explicitly.

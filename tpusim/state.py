@@ -88,10 +88,27 @@ Sentinels/caps are sized so no int32 arithmetic here can overflow:
 ``INF_TIME`` (2^30) > ``TIME_CAP`` (2^29, the farthest a run may advance
 within one chunk before freezing until the next re-base) > ``INTERVAL_CAP``
 (2^27 ms ~ 1.55 days, a clamp on single interval draws whose exceedance
-probability at the 600 s reference mean is e^-223). All cross-miner indexing
-(winner, best-chain owner) is one-hot arithmetic rather than gather/scatter —
-dynamic indexing lowers to serialized gathers on TPU and is the difference
-between a vectorized step and a stalled one.
+probability at the 600 s reference mean is e^-223). Cross-miner indexing
+(winner, best-chain owner) was historically ALL one-hot arithmetic rather
+than gather/scatter; since the miner-axis gather restructuring
+(``SimConfig.consensus_gather``, default on) the hot sweep instead carries
+the best-chain owner as the scalar index ``_best_chain`` already computes
+and reads its rows with ``lax.dynamic_index_in_dim`` — O(M^2) moves instead
+of O(M^3) MACs for the ``cp`` plane read — while every *write* stays a dense
+masked select. The legacy one-hot reads are retained behind the knob for
+A/B timing, bisection, and as the fallback if Mosaic's sublane-axis dynamic
+slice lowers poorly on some TPU generation (next-TPU-window checklist).
+
+**Per-chunk count re-basing** (``SimConfig.count_rebase``, default on)
+extends the time re-base discipline to the block-count leaves: at every
+chunk boundary :func:`rebase_counts` subtracts the per-owner common base
+(the min of owner o's count over every stored prefix) from ``cp`` /
+``own_*`` / ``height``, the engine accumulates the subtracted bases per run
+in its carried aux exactly like elapsed time, and :func:`final_stats`
+re-adds them — so stored counts are bounded by one chunk's growth plus a
+small divergence residual instead of the whole run's block count, and the
+int16 packed layout survives year-long universes (``stale``, the one
+monotone accumulator, is excluded and stays int32).
 
 Everything in this module operates on a single unbatched run; the engine vmaps
 over runs and lax.scans over events.
@@ -202,7 +219,7 @@ class SimState(NamedTuple):
 
 def init_state(
     n_miners: int, group_slots: int, exact: bool, count_dtype=I32,
-    any_selfish: bool = True,
+    any_selfish: bool = True, count_rebase: bool = False,
 ) -> SimState:
     """``count_dtype`` (int32, or int16 when SimConfig.resolved_count_dtype
     packs) types every block-count leaf; every update below derives its
@@ -214,7 +231,12 @@ def init_state(
     selfish-only leaves ``n_private``/``best_height_prev`` to None — both
     are invariantly zero there, and None is an empty pytree leaf, so the
     carry stops paying their HBM round trip (exact mode keeps them even for
-    honest rosters: its kernel leaf list is mode-, not roster-, shaped)."""
+    honest rosters: its kernel leaf list is mode-, not roster-, shaped).
+
+    ``count_rebase`` (SimConfig.count_rebase) keeps ``stale`` int32: it is
+    the one monotone accumulator :func:`rebase_counts` does NOT re-base (it
+    feeds no consensus compare, only final_stats), so under re-basing its
+    packed bound would be the full-duration one the other leaves escaped."""
     m, k = n_miners, group_slots
     cdt = count_dtype
     keep_private = exact or any_selfish
@@ -224,7 +246,7 @@ def init_state(
         best_height_prev=jnp.zeros((), cdt) if keep_private else None,
         height=jnp.zeros((m,), cdt),
         n_private=jnp.zeros((m,), cdt) if keep_private else None,
-        stale=jnp.zeros((m,), cdt),
+        stale=jnp.zeros((m,), I32 if count_rebase else cdt),
         base_tip_arrival=jnp.zeros((m,), TIME),
         group_arrival=jnp.full((m, k), INF_TIME, TIME),
         group_count=jnp.zeros((m, k), cdt),
@@ -261,10 +283,73 @@ def rebase(state: SimState) -> tuple[SimState, jax.Array]:
     ), t
 
 
+def rebase_counts(state: SimState) -> tuple[SimState, jax.Array]:
+    """Shift every block-count leaf down by the per-owner common base;
+    returns ``(state, base)`` with ``base`` int32 [M] — the count twin of
+    :func:`rebase`, called by the engines at each chunk boundary when
+    ``SimConfig.count_rebase`` is on. The host/aux accumulates ``base`` per
+    run exactly like elapsed time; :func:`final_stats` re-adds it.
+
+    ``base[o]`` is the elementwise min of owner ``o``'s count over every
+    stored prefix statistic — by construction no subtraction underflows, and
+    every consensus compare is shift-invariant (heights all move by
+    ``sum(base)``, owner-o counts all by ``base[o]``; the sweep only ever
+    forms differences within one class), so results are bit-identical after
+    the final re-add (pinned by tests/test_consensus_gather.py).
+
+    The lazy diagonals (module docstring) are refreshed to their corrected
+    values FIRST: a diagonal last written many chunks ago would otherwise
+    drift arbitrarily far below the accumulated base. Refreshing is
+    output-invisible — every diagonal read already corrects from
+    ``own_cnt`` — but it pins the min (and therefore the residual bound) to
+    live values. ``stale`` / ``n_private`` / ``group_count`` stay untouched:
+    the first is a monotone accumulator outside the consensus algebra (kept
+    int32 under re-basing), the latter two are bounded by in-flight work."""
+    m = state.height.shape[0]
+    cdt = state.height.dtype
+    eye = jnp.eye(m, dtype=jnp.bool_)
+    own_cnt = state.own_cnt
+    own_in = jnp.where(eye, own_cnt[None, :], state.own_in)
+    own_cp = jnp.where(eye, own_cnt[None, :], state.own_cp)
+    cp = state.cp
+    if cp is not None:
+        # The i == j planes are the stale diagonals; their corrected value
+        # is the (refreshed) own_in row.
+        cp = jnp.where(eye[:, :, None], own_in[:, None, :], cp)
+        base = jnp.min(cp, axis=(0, 1))  # [o] over every (i, j) prefix
+        # own_cp/own_in are derived views of cp in exact mode; folding them
+        # into the min anyway keeps the no-underflow guarantee independent
+        # of that representation invariant.
+        base = jnp.minimum(base, jnp.min(own_cp, axis=1))  # owner = row
+    else:
+        base = jnp.min(own_cp, axis=1)
+    base = jnp.minimum(base, jnp.min(own_in, axis=0))  # owner = column
+    base = jnp.minimum(base, own_cnt)
+    base_h = jnp.sum(base, dtype=cdt)  # heights shift by the total base
+    bhp = state.best_height_prev
+    return state._replace(
+        best_height_prev=None if bhp is None else bhp - base_h,
+        height=state.height - base_h,
+        cp=None if cp is None else cp - base[None, None, :],
+        own_cp=own_cp - base[:, None],
+        own_in=own_in - base[None, :],
+        own_cnt=own_cnt - base,
+    ), base.astype(I32)
+
+
 def _at(vec: jax.Array, onehot: jax.Array) -> jax.Array:
     """vec[w] for one-hot w, as arithmetic (no gather); keeps vec's dtype so
     packed count leaves stay packed."""
     return jnp.sum(jnp.where(onehot, vec, 0), dtype=vec.dtype)
+
+
+def _take_miner(arr: jax.Array, idx: jax.Array, axis: int = 0) -> jax.Array:
+    """``arr[..., idx, ...]`` along ``axis`` for a scalar traced miner index:
+    the consensus_gather read primitive (one dynamic slice — O(size/M) moves
+    — where the one-hot path burned a contract-and-sum over the whole
+    array). Keeps dtype; the index is always in range by construction
+    (_best_chain always has >= 1 candidate)."""
+    return jax.lax.dynamic_index_in_dim(arr, idx, axis=axis, keepdims=False)
 
 
 def _push_groups(
@@ -380,7 +465,8 @@ def _flush_groups(
 
 
 def found_block(
-    state: SimState, params: SimParams, w: jax.Array, any_selfish: bool = True
+    state: SimState, params: SimParams, w: jax.Array, any_selfish: bool = True,
+    gather: bool = True,
 ) -> SimState:
     """Miner ``w`` finds a block at ``state.t``; ``w == -1`` is an identity
     (no one-hot matches), which is how the engine expresses "no find due this
@@ -408,8 +494,16 @@ def found_block(
     onehot_w = jnp.arange(m) == w
     if any_selfish:
         is_selfish = jnp.any(onehot_w & params.selfish)
-        n_private_w = _at(state.n_private, onehot_w)
-        height_w = _at(state.height, onehot_w)
+        if gather:
+            # w == -1 (no find due) clamps to index 0 inside dynamic_slice;
+            # every consumer of these reads is gated on is_selfish, which the
+            # unmatched one-hot forces False, so the clamped values are dead
+            # — bit-equal to the one-hot path by construction.
+            n_private_w = _take_miner(state.n_private, w)
+            height_w = _take_miner(state.height, w)
+        else:
+            n_private_w = _at(state.n_private, onehot_w)
+            height_w = _at(state.height, onehot_w)
         is_race = is_selfish & (n_private_w == 1) & (state.best_height_prev == height_w)
         private_append = is_selfish & ~is_race
         push_count = jnp.where(is_race, 2, 1).astype(cdt)
@@ -453,14 +547,17 @@ def found_block(
 
 def _best_chain(
     height: jax.Array, n_private: jax.Array, group_count: jax.Array, tip: jax.Array
-) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Longest published chain with the first-seen tiebreak (main.cpp:68-82).
 
     Assumes groups hold only unarrived blocks (call after flushing). Returns
-    (owner one-hot, published height per miner, best height, best tip arrival).
-    Ties on both height and tip arrival resolve to the lowest miner index,
-    matching the reference's scan order with strict comparisons.
-    ``n_private`` is None for fast-mode honest rosters (invariantly zero).
+    (owner one-hot, owner index, published height per miner, best height,
+    best tip arrival). Ties on both height and tip arrival resolve to the
+    lowest miner index, matching the reference's scan order with strict
+    comparisons. ``n_private`` is None for fast-mode honest rosters
+    (invariantly zero). The scalar owner index is what the
+    ``consensus_gather`` read path indexes with — always < m, since >= 1
+    candidate exists.
     """
     pub_height = height - jnp.sum(group_count, axis=-1, dtype=group_count.dtype)
     if n_private is not None:
@@ -471,12 +568,12 @@ def _best_chain(
     best_tip = jnp.min(tip_masked)
     winners = cand & (tip_masked == best_tip)
     # First true along the miner axis as a min-index select (the kernel's
-    # construction — no sequential cumsum in the hot sweep); >= 1 candidate
-    # always exists, so the index is always < m.
+    # construction — no sequential cumsum in the hot sweep).
     m = pub_height.shape[0]
     midx = jnp.arange(m)
-    onehot_b = midx == jnp.min(jnp.where(winners, midx, m))
-    return onehot_b, pub_height, best_h, best_tip
+    b_idx = jnp.min(jnp.where(winners, midx, m))
+    onehot_b = midx == b_idx
+    return onehot_b, b_idx, pub_height, best_h, best_tip
 
 
 def notify(
@@ -484,6 +581,7 @@ def notify(
     params: SimParams,
     do: Optional[jax.Array] = None,
     any_selfish: bool = True,
+    gather: bool = True,
 ) -> SimState:
     """One best-chain recompute + notify-all sweep at ``state.t``.
 
@@ -499,7 +597,10 @@ def notify(
     state leaf passes through unchanged. The gate is pushed into the flush /
     reveal / adopt masks so the engine's scan step needs no post-hoc select
     over the state tree. ``any_selfish=False`` (static) drops the reveal logic
-    at trace time for honest-only rosters.
+    at trace time for honest-only rosters. ``gather`` (static,
+    SimConfig.consensus_gather) selects the miner-axis read style: dynamic
+    indexing on the best-chain owner's scalar index (default) vs. the legacy
+    one-hot contract-and-sum — same entries read, bit-identical results.
     """
     m = state.height.shape[0]
     # Every stored arrival is >= NEG_TIME_CAP (pushes stamp t + prop >= 0;
@@ -509,7 +610,7 @@ def notify(
     arr, cnt, base_tip = _flush_groups(
         state.group_arrival, state.group_count, state.base_tip_arrival, t_flush
     )
-    onehot_b, pub_height, best_h, best_tip = _best_chain(
+    onehot_b, b_idx, pub_height, best_h, best_tip = _best_chain(
         state.height, state.n_private, cnt, base_tip
     )
     cdt = state.height.dtype  # the count dtype (int32, or packed int16)
@@ -535,18 +636,27 @@ def notify(
     adopt = best_h > state.height
     if do is not None:
         adopt &= do
-    unpub_b = _at(state.height, onehot_b) - best_h
 
     cp = state.cp
     own_cp, own_in, own_cnt = state.own_cp, state.own_in, state.own_cnt
 
     # Shared between the modes (diagonal corrections per the module
-    # docstring — own_cnt is the authority for every stale diagonal read):
-    cnt_b = _at(own_cnt, onehot_b)  # own chain length in blocks of b
-    # own_cp[:, b] = cp[i, b, i] with the stored (stale) [b, b] entry
-    # corrected: own blocks in the common prefix with b.
-    oc_b = jnp.sum(own_cp * b32[None, :], axis=-1, dtype=cdt)
-    oc_b = oc_b + b32 * (cnt_b - _at(oc_b, onehot_b))
+    # docstring — own_cnt is the authority for every stale diagonal read).
+    # The gather path reads b's rows by the scalar index _best_chain already
+    # computed (O(M^2) moves for the cp plane); the legacy path contracts
+    # against the one-hot (O(M^3) MACs). Same entries, bit-identical.
+    if gather:
+        unpub_b = _take_miner(state.height, b_idx) - best_h
+        cnt_b = _take_miner(own_cnt, b_idx)  # own chain length in blocks of b
+        # own_cp[:, b] = cp[i, b, i] with the stored (stale) [b, b] entry
+        # corrected: own blocks in the common prefix with b.
+        oc_b = _take_miner(own_cp, b_idx, axis=1)
+        oc_b = oc_b + b32 * (cnt_b - _take_miner(oc_b, b_idx))
+    else:
+        unpub_b = _at(state.height, onehot_b) - best_h
+        cnt_b = _at(own_cnt, onehot_b)
+        oc_b = jnp.sum(own_cp * b32[None, :], axis=-1, dtype=cdt)
+        oc_b = oc_b + b32 * (cnt_b - _at(oc_b, onehot_b))
     # Reorg stale accounting (simulation.h:129-135): own blocks above the
     # lca with b are popped on adoption.
     stale = state.stale + jnp.where(adopt, own_cnt - oc_b, 0)
@@ -554,8 +664,12 @@ def notify(
     # minus b's unpublished suffix: per-owner composition of the adopted
     # published chain. (Without the subtraction b's pending blocks would be
     # silently forgotten as future stale.)
-    row_b = jnp.sum(own_in * b32[:, None], axis=0, dtype=cdt)
-    row_b = row_b + b32 * (cnt_b - _at(row_b, onehot_b))
+    if gather:
+        row_b = _take_miner(own_in, b_idx, axis=0)
+        row_b = row_b + b32 * (cnt_b - _take_miner(row_b, b_idx))
+    else:
+        row_b = jnp.sum(own_in * b32[:, None], axis=0, dtype=cdt)
+        row_b = row_b + b32 * (cnt_b - _at(row_b, onehot_b))
     row_bpub = row_b - unpub_b * b32  # [M] per-owner counts of b_pub
 
     if cp is not None:
@@ -564,8 +678,12 @@ def notify(
         # onehot_b selects inside y_val/w_val (and yo/wo) overwrite the
         # b-row with row_bpub — derived from own_in, not cpb — wherever a
         # b-indexed value is used, so no correction is needed.
-        cpb = jnp.sum(cp * b32[:, None, None], axis=0, dtype=cdt)  # [M, M]
-        cpb_diag = jnp.sum(cpb * jnp.eye(m, dtype=cdt), axis=1, dtype=cdt)  # [i] = cp[b, i, i]
+        if gather:
+            cpb = _take_miner(cp, b_idx, axis=0)  # [M, M]
+            cpb_diag = jnp.diagonal(cpb)  # [i] = cp[b, i, i]
+        else:
+            cpb = jnp.sum(cp * b32[:, None, None], axis=0, dtype=cdt)  # [M, M]
+            cpb_diag = jnp.sum(cpb * jnp.eye(m, dtype=cdt), axis=1, dtype=cdt)  # [i] = cp[b, i, i]
 
         # Closed-form cp update: every adopter's chain becomes b's published
         # chain. Factored form — the historical 3-level case analysis
@@ -653,14 +771,23 @@ def earliest_arrival(state: SimState) -> jax.Array:
     return jnp.min(jnp.where(state.group_arrival > state.t, state.group_arrival, INF_TIME))
 
 
-def final_stats(state: SimState, t_end: jax.Array) -> dict[str, jax.Array]:
+def final_stats(
+    state: SimState, t_end: jax.Array, cbase: Optional[jax.Array] = None
+) -> dict[str, jax.Array]:
     """Per-miner stats against the best chain at ``t_end`` (main.cpp:13-41,
     185-191): blocks found in the best chain, share of the best chain, and
     stale blocks per found block. ``t_end`` is the simulation end time in the
     run's current (re-based) frame — the same frame as every stored arrival.
     All ratios are per-run; the runner averages ratios across runs exactly like
     the reference (main.cpp:214-216,230-231).
-    """
+
+    ``cbase`` (int32 [M], or None when SimConfig.count_rebase is off) is the
+    accumulated per-owner count base the chunk-boundary
+    :func:`rebase_counts` calls subtracted: this is the re-add boundary —
+    the winner selection runs on the re-based (uniformly shifted) values,
+    then found counts gain ``cbase`` and the best height ``sum(cbase)``
+    BEFORE any ratio is formed, so every output is bit-identical to an
+    un-rebased run."""
     m = state.height.shape[0]
     unarrived = jnp.sum(state.group_count * (state.group_arrival > t_end), axis=-1, dtype=I32)
     pub_height = state.height - unarrived
@@ -682,8 +809,16 @@ def final_stats(state: SimState, t_end: jax.Array) -> dict[str, jax.Array]:
     own_in_b = jnp.sum(state.own_in * b32[:, None], axis=0, dtype=I32)
     own_in_b = own_in_b + b32 * (_at(state.own_cnt, onehot_b) - _at(own_in_b, onehot_b))
     unpub_b = _at(state.height, onehot_b) - best_h
-    found = own_in_b - unpub_b * b32
-    denom = jnp.maximum(best_h, 1).astype(jnp.float32)
+    found = own_in_b - unpub_b.astype(I32) * b32
+    best_h32 = best_h.astype(I32)
+    if cbase is not None:
+        # Count re-base re-add (rebase_counts): found counts are short by
+        # each owner's accumulated base, the best height by their total.
+        # Re-added in int32 BEFORE the sign tests and ratios below, so
+        # fpos/share/stale_rate see the true values.
+        found = found + cbase
+        best_h32 = best_h32 + jnp.sum(cbase)
+    denom = jnp.maximum(best_h32, 1).astype(jnp.float32)
     fpos = found > 0
     share = jnp.where(fpos, found.astype(jnp.float32) / denom, 0.0)
     stale_rate = jnp.where(
@@ -693,10 +828,10 @@ def final_stats(state: SimState, t_end: jax.Array) -> dict[str, jax.Array]:
         # int32 outputs regardless of the packed count dtype: this is the
         # boundary where packing ends — the engine's finalize sums these
         # over the runs axis, which int16 could not survive.
-        "blocks_found": found.astype(I32),
+        "blocks_found": found,
         "blocks_share": share,
         "stale_rate": stale_rate,
         "stale_blocks": state.stale.astype(I32),
-        "best_height": best_h.astype(I32),
+        "best_height": best_h32,
         "overflow": state.overflow,
     }
