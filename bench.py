@@ -478,6 +478,10 @@ def main() -> int:
         info["state_dtype"] = config.resolved_count_dtype
         info["consensus_gather"] = config.consensus_gather
         info["count_rebase"] = config.count_rebase
+        # Single-config benchmark: always the UNPACKED program (grid packing
+        # is a sweep-level dispatch mode, tpusim.packed) — pinned so the
+        # trajectory stays one program if bench ever grows a packed mode.
+        info["packed"] = False
 
         phase = "headline-compile"
         # Compile + warm up (first TPU compile is slow and must not be timed).
@@ -560,6 +564,7 @@ def main() -> int:
                 "state_dtype": exact_cfg.resolved_count_dtype,
                 "consensus_gather": exact_cfg.consensus_gather,
                 "count_rebase": exact_cfg.count_rebase,
+                "packed": False,
             }
             t0 = time.monotonic()
             try:
@@ -650,6 +655,7 @@ def main() -> int:
                         "state_dtype": info["state_dtype"],
                         "consensus_gather": info["consensus_gather"],
                         "count_rebase": info["count_rebase"],
+                        "packed": info["packed"],
                     },
                     extra={"elapsed_s": round(elapsed, 2), "runs": total_runs},
                 )]
@@ -668,6 +674,7 @@ def main() -> int:
                             "state_dtype": einfo["state_dtype"],
                             "consensus_gather": einfo["consensus_gather"],
                             "count_rebase": einfo["count_rebase"],
+                            "packed": einfo["packed"],
                         },
                         extra={"elapsed_s": einfo["elapsed_s"],
                                "runs": einfo["runs"]},

@@ -160,12 +160,59 @@ echo "== perf observability (regression ledger + noise gate) =="
 # shape still swings (the committed baseline's own spread is ~26%); a real
 # regression like the synthetic 2x pinned in tests/test_perf_obs.py clears
 # that floor either way.
+# The quick run includes the packed_sweep scenario (sweep_sequential +
+# sweep_packed points/sec on the scaled reference selfish-threshold grid),
+# so the compare below also gates the grid-packing speedup against its
+# regenerated calibration row.
 env JAX_PLATFORMS=cpu python -m tpusim.cli perf run --quick \
   --out "$tele_dir/perf_quick.jsonl"
 env JAX_PLATFORMS=cpu python -m tpusim.cli perf compare \
   artifacts/perf/calibration_cpu.jsonl "$tele_dir/perf_quick.jsonl" \
   --min-margin 0.5
 python -m tpusim.cli perf report "$tele_dir/perf_quick.jsonl" > /dev/null
+
+echo "== packed-sweep leg (grid packing bit-equality) =="
+# Device-side grid packing (tpusim.packed): the same small selfish-threshold
+# grid through the sequential and the packed run_sweep paths, output files
+# diffed LINE-FOR-LINE minus the wall-clock fields (elapsed_s/compile_s —
+# the fleet-leg strip), and the packed per-point convergence panel rendered
+# by BOTH dashboards. The points/sec perf gate for packing rides the
+# perf-observability leg above.
+packed_dir="$tele_dir/packed"
+mkdir -p "$packed_dir"
+env JAX_PLATFORMS=cpu python - "$packed_dir" <<'EOF'
+import json, sys
+from pathlib import Path
+from tpusim.config import NetworkConfig, SimConfig
+from tpusim.sweep import _selfish_network, run_sweep
+
+out = Path(sys.argv[1])
+pts = []
+for interval_s in (300.0, 600.0):
+    for pct in (30, 40):
+        net = _selfish_network(pct)
+        net = NetworkConfig(miners=net.miners, block_interval_s=interval_s)
+        pts.append((f"i{int(interval_s)}-s{pct}",
+                    SimConfig(network=net, runs=8, duration_ms=86_400_000,
+                              batch_size=8)))
+cache: dict = {}
+run_sweep(pts, quiet=True, engine_cache=cache, out_path=out / "seq.jsonl")
+run_sweep(pts, quiet=True, engine_cache=cache, packed=True,
+          out_path=out / "packed.jsonl",
+          telemetry_path=out / "packed.tele.jsonl")
+for name in ("seq", "packed"):
+    rows = [json.loads(ln) for ln in (out / f"{name}.jsonl").open()]
+    for r in rows:
+        r.pop("elapsed_s", None); r.pop("compile_s", None)
+    (out / f"{name}.stripped").write_text(
+        "\n".join(json.dumps(r) for r in rows) + "\n")
+EOF
+diff "$packed_dir/seq.stripped" "$packed_dir/packed.stripped"
+python -m tpusim watch --once "$packed_dir/packed.tele.jsonl" \
+  | grep -q "by grid point"
+env JAX_PLATFORMS=cpu python -m tpusim report "$packed_dir/packed.tele.jsonl" \
+  | grep -q "Convergence by grid point"
+echo "packed sweep: rows line-identical + per-point panels rendered"
 
 echo "== fleet kill-drill smoke =="
 # The elastic-fleet healing contract end to end (tpusim.fleet): two

@@ -32,6 +32,11 @@ def main() -> int:
     p.add_argument("--attempt", type=int, default=0)
     p.add_argument("--behavior", default="ok")
     p.add_argument("--runs", type=int, default=4)
+    p.add_argument(
+        "--grid", default=None,
+        help="packed sub-grid manifest (tpusim.packed units): publish one "
+        "row per member point in a {'rows': [...]} payload",
+    )
     args = p.parse_args()
 
     with open(args.heartbeat, "a") as fh:
@@ -51,14 +56,22 @@ def main() -> int:
         while True:
             time.sleep(60)
 
-    row = {
-        "runs": args.runs, "point": args.point, "backend": "tpu",
-        "elapsed_s": 0.01, "attempt": args.attempt,
-        "chaos_env": "TPUSIM_FLEET_WORKER_CHAOS" in os.environ,
-    }
+    def row_for(point: str) -> dict:
+        return {
+            "runs": args.runs, "point": point, "backend": "tpu",
+            "elapsed_s": 0.01, "attempt": args.attempt,
+            "chaos_env": "TPUSIM_FLEET_WORKER_CHAOS" in os.environ,
+        }
+
+    if args.grid is not None:
+        with open(args.grid) as fh:
+            manifest = json.load(fh)
+        payload = {"rows": [row_for(e["point"]) for e in manifest["points"]]}
+    else:
+        payload = row_for(args.point)
     tmp = args.result + ".tmp"
     with open(tmp, "w") as fh:
-        json.dump(row, fh)
+        json.dump(payload, fh)
     os.replace(tmp, args.result)
     return 0
 

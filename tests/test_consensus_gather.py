@@ -60,7 +60,12 @@ def _assert_sums_equal(a: dict, b: dict, msg: str) -> None:
 
 
 @pytest.mark.parametrize("config", [FAST, EXACT], ids=["fast", "exact-selfish"])
-@pytest.mark.parametrize("k", [1, 2])
+# K=2 rides the slow tier (ci.sh's unfiltered pytest leg): the K-lookahead
+# consumption-order equivalence has its own pins in test_rng_batch, so
+# tier-1 keeps the K=1 gather-vs-onehot pair only.
+@pytest.mark.parametrize(
+    "k", [1, pytest.param(2, marks=pytest.mark.slow)]
+)
 def test_gather_vs_onehot_bit_equal(config, k):
     """The gather path reads exactly the entries the one-hot contraction
     summed, across honest and selfish rosters and superstep widths."""
@@ -176,6 +181,10 @@ def test_dispatch_paths_bit_identical_with_knobs():
     _assert_sums_equal(device, eng.run_batch_async(keys)(), "async")
 
 
+# Slow tier (ci.sh's unfiltered pytest leg): scan-vs-pallas parity under the
+# DEFAULT knobs already rides tier-1 via test_pallas_engine; this adds the
+# legacy one-hot kernel path and the flight-armed densest-leaf combo.
+@pytest.mark.slow
 def test_scan_vs_pallas_gather_and_rebase():
     """The kernel's take_along_axis gather reads and the (outside-kernel)
     count re-base are pinned bit-equal to the scan engine AND to the

@@ -1,0 +1,773 @@
+"""Device-side grid packing (tpusim.packed): whole sweep grids as ONE
+compiled device program, bit-equal to the sequential sweep.
+
+The contract under test, per layer:
+
+  * **Planning (jax-free)** — shape-agreement grouping (``pack_shape_key``),
+    the fallback rules (``packable``), and the worst-case count-dtype
+    resolution (``packed_count_dtype``) including its fail-loud int16 rule.
+  * **Dispatch** — packed rows/moments/counters BIT-equal to the sequential
+    sweep on both engines and all dispatch paths; ragged horizons; pad
+    lanes; exactly one compile for a whole same-shape grid
+    (``compile_count_guard(exact=0)`` on the second grid).
+  * **combine_sums segment rules** — the ``*_per_run`` concat branch:
+    split-vs-whole bit-equality (512-vs-256), associativity, and
+    permutation invariance of the downstream per-point folds.
+  * **Drivers** — ``run_sweep(packed=True)`` row schema/order and fallback
+    mixing, the adaptive ``ci_target_stat`` lane allocator, the fleet's
+    packed sub-grid units, and the watch/report per-point panels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from tpusim.config import NetworkConfig, SimConfig, default_network
+from tpusim.convergence import MomentAccumulator, point_snapshot_rows
+from tpusim.engine import Engine, combine_sums
+from tpusim.packed import (
+    _dispatch,
+    _fold_piece,
+    _make_packed_engine,
+    _Piece,
+    _resolved_chunk_steps,
+    _zero_point_sums,
+    _zero_point_tele,
+    pack_shape_key,
+    packable,
+    packed_count_dtype,
+    plan_packs,
+    run_grid,
+    run_grid_adaptive,
+)
+from tpusim.runner import make_run_keys
+from tpusim.sweep import _selfish_network, run_sweep
+from tpusim.telemetry import TelemetryRecorder, load_spans
+from tpusim.testing import compile_count_guard
+
+DAY = 86_400_000
+
+#: Module-shared compiled-engine cache: the packed program for the reference
+#: grid shape compiles once for the whole file (the tier-1 affordability
+#: discipline of tests/test_chaos.py).
+CACHE: dict = {}
+
+#: Wall-clock row fields stripped before bit-equality comparisons — the same
+#: strip scripts/ci.sh applies to fleet rows.
+_WALL = ("elapsed_s", "compile_s")
+
+
+def _grid(runs: int = 12, batch: int = 8, duration: int = DAY):
+    """2 intervals x 2 selfish pcts — a small selfish-threshold grid whose
+    points all share one pack_shape_key."""
+    pts = []
+    for interval_s in (300.0, 600.0):
+        for pct in (30, 40):
+            net = _selfish_network(pct)
+            net = NetworkConfig(miners=net.miners, block_interval_s=interval_s)
+            pts.append((
+                f"i{int(interval_s)}-s{pct}",
+                SimConfig(network=net, runs=runs, duration_ms=duration,
+                          batch_size=batch),
+            ))
+    return pts
+
+
+def _strip(rows: list[dict]) -> list[dict]:
+    return [{k: v for k, v in r.items() if k not in _WALL} for r in rows]
+
+
+def _run_grid_all(pts, **kw):
+    """plan_packs + run_grid per pack, entries stitched back in point order
+    (what run_sweep(packed=True) does, minus the row plumbing)."""
+    packs, sequential = plan_packs(pts)
+    assert sequential == []
+    entries: dict[int, dict] = {}
+    for pack in packs:
+        group = [pts[i] for i in pack.indices]
+        for i, e in zip(pack.indices, run_grid(group, **kw)):
+            entries[i] = e
+    return [entries[i] for i in range(len(pts))]
+
+
+@pytest.fixture(scope="module")
+def seq_rows():
+    return run_sweep(_grid(), quiet=True, engine_cache=CACHE)
+
+
+@pytest.fixture(scope="module")
+def packed_entries():
+    return _run_grid_all(_grid(), engine_cache=CACHE)
+
+
+# ---------------------------------------------------------------------------
+# Planning (jax-free).
+
+
+def test_planner_groups_same_shape_and_routes_fallbacks():
+    pts = _grid()
+    packs, sequential = plan_packs(pts)
+    # The grid spans two block intervals -> two resolved chunk budgets (the
+    # budget is sampling identity: packing must not change any point's
+    # draws), so the planner forms one pack PER interval; the two rosters
+    # within an interval share one pack (they differ only in runtime
+    # params).
+    assert len(packs) == 2 and sequential == []
+    assert [p.indices for p in packs] == [[0, 1], [2, 3]]
+    # xoroshiro and flight-recorder points take the sequential path.
+    xoro = dataclasses.replace(pts[0][1], rng="xoroshiro")
+    flight = dataclasses.replace(pts[1][1], flight_capacity=64)
+    assert not packable(xoro) and not packable(flight)
+    packs, sequential = plan_packs(
+        [pts[0], ("x", xoro), ("f", flight), pts[1]]
+    )
+    assert sequential == [1, 2]
+    assert [p.indices for p in packs] == [[0, 3]]
+    # A different miner count is a different program shape -> its own pack.
+    other = SimConfig(network=default_network(), runs=8,
+                      duration_ms=DAY, batch_size=8)
+    packs, _ = plan_packs([pts[0], ("honest", other)])
+    assert len(packs) == 2
+    assert pack_shape_key(pts[0][1]) != pack_shape_key(other)
+
+
+def test_chunk_steps_twin_pinned_to_engine():
+    """The jax-free chunk-budget twin must equal Engine's resolution — the
+    same twin discipline as SimConfig._event_bound vs default_n_steps."""
+    for cfg in (
+        _grid()[0][1],
+        _grid(duration=2 * DAY)[1][1],
+        dataclasses.replace(_grid()[2][1], chunk_steps=256),
+        SimConfig(network=default_network(), runs=8, duration_ms=365 * DAY),
+    ):
+        assert _resolved_chunk_steps(cfg) == Engine(cfg).chunk_steps, cfg
+
+
+def test_packed_count_dtype_worst_case_rules():
+    small = _grid()[0][1]                      # rebased 1-day: int16 domain
+    assert small.resolved_count_dtype == "int16"
+    assert packed_count_dtype([small, small]) == "int16"
+    # A selfish MAJORITY gets the full divergence budget back (PR 10) and
+    # exceeds int16 at year length — the pack's worst case widens EVERYONE.
+    majority = SimConfig(
+        network=_selfish_network(55), runs=4, duration_ms=365 * DAY,
+        batch_size=4,
+    )
+    assert majority.resolved_count_dtype == "int32"
+    minority = dataclasses.replace(majority, network=_selfish_network(30))
+    assert minority.resolved_count_dtype == "int16"
+    assert packed_count_dtype([minority, majority]) == "int32"
+    # Explicit int16 the pack cannot honor fails LOUD, never silently wide.
+    explicit16 = dataclasses.replace(minority, state_dtype="int16")
+    with pytest.raises(ValueError, match="worst-case"):
+        packed_count_dtype([explicit16, majority])
+    # Explicit int32 anywhere forces the pack wide; mixing it with an
+    # explicit int16 request is a contradiction, not a preference.
+    explicit32 = dataclasses.replace(small, state_dtype="int32")
+    assert packed_count_dtype([small, explicit32]) == "int32"
+    with pytest.raises(ValueError, match="mixes"):
+        packed_count_dtype([explicit16, explicit32])
+
+
+def test_pack_chunk_limit_covers_shorter_interval_members():
+    """pack_shape_key omits the block interval (the 4096 clamp makes
+    short-interval chunk budgets coincide), so one pack can mix intervals —
+    the representative must take the worst-event-bound member's network, or
+    a shorter-interval member than configs[0] exhausts the chunk loop
+    ('batch did not finish within N chunks')."""
+    miners = _selfish_network(40).miners
+    a = SimConfig(
+        network=NetworkConfig(miners=miners, block_interval_s=240.0),
+        runs=4, duration_ms=365 * DAY, batch_size=4,
+    )
+    b = dataclasses.replace(
+        a, network=NetworkConfig(miners=miners, block_interval_s=60.0)
+    )
+    assert pack_shape_key(a) == pack_shape_key(b)
+    eng = _make_packed_engine([a, b])
+    for member in (a, b):
+        assert eng.max_chunks >= Engine(member).max_chunks, member
+
+
+def test_synthetic_representative_overflow_widens_not_raises():
+    """A pack whose members all fit int16 individually can still have a
+    synthetic representative (first roster x the pack-max duration) whose
+    count bound does not — the engine builder must widen to int32, not
+    crash in SimConfig.__post_init__ before its widening check runs."""
+    net = _selfish_network(40)
+    a = SimConfig(
+        network=NetworkConfig(miners=net.miners, block_interval_s=10.0),
+        runs=4, duration_ms=DAY, batch_size=4, count_rebase=False,
+    )
+    b = dataclasses.replace(
+        a, network=NetworkConfig(miners=net.miners, block_interval_s=40.0),
+        duration_ms=4 * DAY,
+    )
+    # Preconditions that make this the overflow case: one pack, each
+    # member's own bound fits int16, the representative's does not.
+    assert pack_shape_key(a) == pack_shape_key(b)
+    assert packed_count_dtype([a, b]) == "int16"
+    rep_probe = dataclasses.replace(
+        a, duration_ms=b.duration_ms, chunk_steps=_resolved_chunk_steps(a)
+    )
+    assert not rep_probe._count_bound_fits_int16
+    eng = _make_packed_engine([a, b])
+    assert eng.config.resolved_count_dtype == "int32"
+
+
+# ---------------------------------------------------------------------------
+# Packed dispatch: bit-equality with the sequential sweep.
+
+
+def test_packed_rows_bit_equal_sequential(seq_rows, packed_entries):
+    """Every per-point row (SimResults payload) lands bit-equal to the
+    sequential sweep, in point order."""
+    assert [e["name"] for e in packed_entries] == [r["point"] for r in seq_rows]
+    for row, entry in zip(seq_rows, packed_entries):
+        got = entry["results"].to_dict()
+        for k, v in row.items():
+            if k in _WALL or k in ("point", "backend"):
+                continue
+            assert got[k] == v, (entry["name"], k)
+
+
+def test_packed_moments_and_counters_bit_equal_sequential(packed_entries):
+    """The int64 moment accumulators and SimCounters land per-point
+    bit-equal to a sequential per-point fold of the same batches. One point
+    per pack (the grid spans two) pins both compiled programs at half the
+    tier-1 cost — the rows test covers all four points."""
+    from tpusim.runner import make_engine
+
+    probe = [(_grid()[i], packed_entries[i]) for i in (0, 3)]
+    for (name, cfg), entry in probe:
+        eng = make_engine(cfg, cache=CACHE)
+        acc = MomentAccumulator()
+        tele = _zero_point_tele(cfg.network.n_miners)
+        for start in range(0, cfg.runs, cfg.batch_size):
+            n = min(cfg.batch_size, cfg.runs - start)
+            out = eng.run_batch(make_run_keys(cfg.seed, start, n))
+            acc.add(out)
+            tele["reorg_depth_max"] = max(
+                tele["reorg_depth_max"], int(out["tele_reorg_depth_max"])
+            )
+            tele["stale_events"] += int(out["tele_stale_events_sum"])
+            tele["active_steps"] += int(out["tele_active_steps_sum"])
+            tele["stale_by_miner"] = (
+                tele["stale_by_miner"] + out["tele_stale_by_miner_sum"]
+            )
+            tele["reorg_depth_hist"] = (
+                tele["reorg_depth_hist"] + out["tele_reorg_depth_hist_sum"]
+            )
+        got_m, got_t = entry["moments"], entry["tele"]
+        assert got_m.n == acc.n == cfg.runs
+        for stat in acc.m1:
+            assert np.array_equal(got_m.m1[stat], acc.m1[stat]), (name, stat)
+            assert np.array_equal(got_m.m2[stat], acc.m2[stat]), (name, stat)
+        for k in tele:
+            assert np.array_equal(got_t[k], tele[k]), (name, k)
+
+
+def test_second_same_shape_grid_compiles_nothing(seq_rows, packed_entries):
+    """The acceptance pin: a second same-shape grid through the warmed cache
+    dispatches with ZERO XLA compiles, and run_sweep(packed=True) rows are
+    the fixture rows bit-for-bit. The ride-along ``progress`` callback must
+    arrive SWEEP-cumulative across the grid's two packs (run_sweep wraps
+    each group's callback with a running base) without costing a compile."""
+    calls: list[tuple[int, int]] = []
+    with compile_count_guard(exact=0):
+        rows = run_sweep(_grid(), quiet=True, packed=True, engine_cache=CACHE,
+                         progress=lambda d, t: calls.append((d, t)))
+    assert _strip(rows) == _strip(seq_rows)
+    total = sum(c.runs for _, c in _grid())
+    assert calls[-1] == (total, total)
+    assert all(t == total for _, t in calls)
+    assert [d for d, _ in calls] == sorted(d for d, _ in calls)
+
+
+def test_packed_dispatch_paths_bit_equal(packed_entries):
+    """host-loop and pipelined packed dispatches produce the same rows as
+    the device-loop path (the engines' three-path contract, packed). One
+    pack is enough — the path split is per-program, not per-pack."""
+    for kw in ({"host_loop": True}, {"pipelined": True}):
+        out = run_grid(_grid()[2:], engine_cache=CACHE, **kw)
+        for a, b in zip(packed_entries[2:], out):
+            assert a["sums"].keys() == b["sums"].keys()
+            for k in a["sums"]:
+                assert np.array_equal(a["sums"][k], b["sums"][k]), (kw, k)
+
+
+def test_ragged_horizons_pack_and_match_sequential():
+    """Points with DIFFERENT durations pack together when their resolved
+    chunk budgets agree (explicit chunk_steps): each run carries its own
+    horizon through the per-run ledger, bit-equal to sequential."""
+    net = _selfish_network(35)
+    pts = [
+        (f"d{d}", SimConfig(network=net, runs=5, duration_ms=d * DAY // 2,
+                            batch_size=8, chunk_steps=128))
+        for d in (1, 2)
+    ]
+    packs, sequential = plan_packs(pts)
+    assert len(packs) == 1 and sequential == []
+    cache: dict = {}
+    seq = run_sweep(pts, quiet=True, engine_cache=cache)
+    entries = run_grid(pts, engine_cache=cache)
+    for row, entry in zip(seq, entries):
+        got = entry["results"].to_dict()
+        for k, v in row.items():
+            if k not in _WALL and k not in ("point", "backend"):
+                assert got[k] == v, (entry["name"], k)
+
+
+# Slow tier (ci.sh's unfiltered pytest leg): the widening RULES ride tier-1
+# jax-free (test_packed_count_dtype_worst_case_rules and the synthetic-
+# representative overflow test); this adds the end-to-end bit-equality belt
+# on a 120-day widened pack.
+@pytest.mark.slow
+def test_pack_widens_mixed_dtype_grid_and_stays_bit_equal():
+    """A pack mixing an int16-domain point with an int32 point runs the
+    WHOLE batch int32 — and the int16 point's results are still bit-equal
+    to its sequential (int16) run, because the count dtype is not part of
+    the sampling identity."""
+    majority = SimConfig(
+        network=_selfish_network(55), runs=4, duration_ms=120 * DAY,
+        batch_size=4,
+    )
+    minority = dataclasses.replace(majority, network=_selfish_network(30))
+    pts = [("min30", minority), ("maj55", majority)]
+    packs, sequential = plan_packs(pts)
+    assert len(packs) == 1 and sequential == []
+    eng = _make_packed_engine([minority, majority])
+    assert eng.config.resolved_count_dtype == "int32"
+    cache: dict = {}
+    seq = run_sweep(pts, quiet=True, engine_cache=cache)
+    entries = run_grid(pts, engine_cache=cache)
+    for row, entry in zip(seq, entries):
+        got = entry["results"].to_dict()
+        for k, v in row.items():
+            if k not in _WALL and k not in ("point", "backend"):
+                assert got[k] == v, (entry["name"], k)
+
+
+def test_packed_engine_validation():
+    cfg = _grid()[0][1]
+    with pytest.raises(ValueError, match="xoroshiro"):
+        Engine(dataclasses.replace(cfg, rng="xoroshiro"), packed=True)
+    with pytest.raises(ValueError, match="tpu backend"):
+        run_sweep(_grid(), backend="cpp", packed=True, quiet=True)
+
+
+def test_checkpoint_dir_falls_back_sequential(tmp_path, caplog):
+    """Packing has no per-point checkpoints: --checkpoint-dir disables it
+    with a warning, and the rows still land (sequential path)."""
+    pts = _grid()[:1]
+    with caplog.at_level("WARNING", logger="tpusim"):
+        rows = run_sweep(
+            pts, quiet=True, packed=True, engine_cache=CACHE,
+            checkpoint_dir=tmp_path / "ckpt",
+        )
+    assert "falls back to the sequential path" in caplog.text
+    assert len(rows) == 1 and rows[0]["compile_s"] is not None
+
+
+def test_mixed_grid_falls_back_per_point_in_order(seq_rows):
+    """A grid mixing packable and xoroshiro points keeps the EXACT output
+    point order, with the fallback point's row equal to its own sequential
+    run."""
+    pts = _grid()
+    xoro_cfg = dataclasses.replace(pts[1][1], rng="xoroshiro")
+    mixed = [pts[0], ("xoro", xoro_cfg), pts[2]]
+    rows = run_sweep(mixed, quiet=True, packed=True, engine_cache=CACHE)
+    assert [r["point"] for r in rows] == [pts[0][0], "xoro", pts[2][0]]
+    by_point = {r["point"]: r for r in _strip(rows)}
+    want = {r["point"]: r for r in _strip(seq_rows)}
+    assert by_point[pts[0][0]] == want[pts[0][0]]
+    assert by_point[pts[2][0]] == want[pts[2][0]]
+
+
+# ---------------------------------------------------------------------------
+# combine_sums segment-axis rules.
+
+
+def _packed_raw(members, pieces, width, cache=CACHE):
+    eng = _make_packed_engine([c for _, c in members], engine_cache=cache)
+    return eng, _dispatch(eng, [c for _, c in members], pieces, width)
+
+
+def test_split_dispatch_concat_bit_equal_512_vs_256():
+    """One 512-run packed dispatch (2 points x 256) == two 256-run
+    dispatches combine_sums'd, BIT-equal on every raw leaf — the
+    ``*_per_run`` concat rule plus the additive/max rules with segments
+    attached."""
+    net = default_network(propagation_ms=1000)
+    members = [
+        ("a", SimConfig(network=net, runs=256, batch_size=256, seed=3,
+                        duration_ms=3_600_000)),
+        ("b", SimConfig(network=net, runs=256, batch_size=256, seed=7,
+                        duration_ms=3_600_000)),
+    ]
+    cache: dict = {}
+    _, whole = _packed_raw(
+        members, [_Piece(0, 0, 256), _Piece(1, 0, 256)], 512, cache
+    )
+    _, half_a = _packed_raw(members, [_Piece(0, 0, 256)], 256, cache)
+    _, half_b = _packed_raw(members, [_Piece(1, 0, 256)], 256, cache)
+    merged = combine_sums(half_a, half_b)
+    assert merged.keys() == whole.keys()
+    for k in whole:
+        assert np.array_equal(merged[k], whole[k]), k
+
+
+def test_combine_sums_segment_rules_associative_and_permutation():
+    """Associativity of the merge on raw packed outputs, and permutation
+    invariance of the downstream per-point segment folds (the property that
+    lets dispatch order never matter). Built entirely on the module CACHE's
+    width-8 pack program (pad lanes included) — zero extra compiles."""
+    members = _grid()[:2]
+    pieces = [_Piece(0, 0, 2), _Piece(1, 0, 2), _Piece(0, 2, 2)]
+    parts = [_packed_raw(members, [p], 8)[1] for p in pieces]
+    ab_c = combine_sums(combine_sums(parts[0], parts[1]), parts[2])
+    a_bc = combine_sums(parts[0], combine_sums(parts[1], parts[2]))
+    assert ab_c.keys() == a_bc.keys()
+    for k in ab_c:
+        assert np.array_equal(ab_c[k], a_bc[k]), k
+
+    # Per-point folds are permutation-invariant over pieces: folding the
+    # same segments in any dispatch order yields identical accumulators
+    # (point 0 receives TWO pieces, so cross- and within-point order are
+    # both exercised).
+    m = members[0][1].network.n_miners
+    raw = _packed_raw(members, pieces, 8)[1]
+    offs = [0, 2, 4]
+
+    def fold(order):
+        st = [
+            {"sums": _zero_point_sums(m), "moments": MomentAccumulator(),
+             "tele": _zero_point_tele(m)}
+            for _ in range(2)
+        ]
+        for j in order:
+            _fold_piece(st[pieces[j].point], raw, slice(offs[j], offs[j] + 2))
+        return st
+
+    fwd, rev = fold([0, 1, 2]), fold([2, 0, 1])
+    for sf, sr in zip(fwd, rev):
+        for k in sf["sums"]:
+            assert np.array_equal(sf["sums"][k], sr["sums"][k]), k
+        assert sf["moments"].n == sr["moments"].n
+        for stat in sf["moments"].m1:
+            assert np.array_equal(sf["moments"].m1[stat], sr["moments"].m1[stat])
+            assert np.array_equal(sf["moments"].m2[stat], sr["moments"].m2[stat])
+
+
+def test_packed_big_seed_matches_sequential_and_reports_progress():
+    """Seeds past uint32: ``jax.random.key`` WRAPS out-of-range Python ints,
+    so the sequential path accepts them — the packed key build must inherit
+    that construction (a raw ``np.uint32`` cast raises under numpy 2.x
+    instead of wrapping). The point's 8+4 pieces also span two dispatches,
+    pinning ``run_grid``'s per-dispatch grid-cumulative ``progress``
+    callback (the runner's contract, so fleet heartbeats carry packed
+    progress)."""
+    pts = [
+        (n, dataclasses.replace(c, seed=2**32 + 7)) for n, c in _grid()[2:3]
+    ]
+    seq = run_sweep(pts, quiet=True, engine_cache=CACHE)
+    calls: list[tuple[int, int]] = []
+    entries = _run_grid_all(pts, engine_cache=CACHE,
+                            progress=lambda d, t: calls.append((d, t)))
+    for row, entry in zip(seq, entries):
+        got = entry["results"].to_dict()
+        for k, v in row.items():
+            if k not in _WALL and k not in ("point", "backend"):
+                assert got[k] == v, (entry["name"], k)
+    total = pts[0][1].runs
+    assert len(calls) > 1 and calls[-1] == (total, total)
+    assert [d for d, _ in calls] == sorted(d for d, _ in calls)
+
+
+# ---------------------------------------------------------------------------
+# Pallas engine.
+
+
+def test_pallas_packed_bit_equal_scan(packed_entries):
+    """The packed pallas kernel (per-run (M, R) prop/selfish refs, pad
+    lanes up to the 128 tile) lands bit-equal to the packed scan engine —
+    which the fixtures pin bit-equal to the sequential sweep."""
+    # One interval's pack is enough to pin the kernel path (the interpret
+    # twin is slow; the 600 s-interval pack has the fewest steps).
+    out = _run_grid_all(
+        _grid()[2:], engine="pallas", pallas_kwargs={"interpret": True},
+    )
+    for a, b in zip(packed_entries[2:], out):
+        for k in a["sums"]:
+            assert np.array_equal(a["sums"][k], b["sums"][k]), k
+        assert a["moments"].n == b["moments"].n
+        for stat in a["moments"].m1:
+            assert np.array_equal(a["moments"].m1[stat], b["moments"].m1[stat])
+
+
+def test_pallas_packed_guards():
+    from tpusim.pallas_engine import PallasEngine
+
+    cfg = dataclasses.replace(
+        _grid()[0][1], batch_size=128, runs=128,
+    )
+    with pytest.raises(ValueError, match="rng_batch"):
+        PallasEngine(dataclasses.replace(cfg, rng_batch=False),
+                     packed=True, interpret=True)
+    # A packed dispatch not padded to the run tile is a caller bug: the
+    # per-run params would silently misalign under a head/tail split.
+    eng = PallasEngine(cfg, tile_runs=128, step_block=64,
+                       interpret=True, packed=True)
+    with pytest.raises(ValueError, match="pad the pack width"):
+        eng.run_batch(make_run_keys(0, 0, 130))
+
+
+# ---------------------------------------------------------------------------
+# Adaptive runs-per-point allocation.
+
+
+def test_adaptive_allocates_lanes_to_wide_ci_points(tmp_path):
+    """The ci_target_stat driver inside the packed batch: with an
+    unreachable target, round 2 must allocate MORE lanes to the point whose
+    round-1 CI was widest, and every point's moments cover exactly the runs
+    it executed."""
+    pts = _grid(runs=64, batch=16)[:2]
+    tele = tmp_path / "adaptive.jsonl"
+    rec = TelemetryRecorder(tele)
+    out = run_grid_adaptive(
+        pts, ci_target_stat="blocks_share", ci_target_rel=1e-4,
+        lanes=16, max_rounds=2, engine_cache=CACHE, telemetry=rec,
+    )
+    rec.close()
+    for (name, cfg), entry in zip(pts, out):
+        assert entry["results"].runs == entry["moments"].n <= cfg.runs
+        assert entry["converged"] is False  # 1e-4 is unreachable in 2 rounds
+    spans = [s for s in load_spans(tele) if s["span"] == "stats"]
+    r2 = {s["attrs"]["point"]: s["attrs"] for s in spans
+          if s["attrs"].get("round") == 2}
+    r1 = {s["attrs"]["point"]: s["attrs"] for s in spans
+          if s["attrs"].get("round") == 1}
+    assert set(r2) == {pts[0][0], pts[1][0]}
+    rel1 = {
+        p: a["stats"]["blocks_share"]["rel_hw_max"] for p, a in r1.items()
+    }
+    wide = max(rel1, key=rel1.get)
+    narrow = min(rel1, key=rel1.get)
+    if rel1[wide] > rel1[narrow]:
+        assert r2[wide]["lanes"] >= r2[narrow]["lanes"]
+
+
+def test_allocate_lanes_respects_min_runs_floor():
+    """Integer-rounding overshoot is trimmed from the smallest-need points
+    but never below the min_runs floor (a 1-run round yields no usable CI),
+    and a point whose remaining budget is under the floor just takes what it
+    has left."""
+    from tpusim.packed import _allocate_lanes
+
+    # Rounding pushes the raw allocation to 8 > lanes=6; the two floor
+    # points must NOT be trimmed to 1 — only the wide point gives back.
+    alloc = _allocate_lanes(
+        [0, 1, 2], {0: 5.0, 1: 1.0, 2: 1.0},
+        {0: 64, 1: 64, 2: 64}, lanes=6, min_runs=2,
+    )
+    assert sum(alloc.values()) <= 6
+    assert all(v >= 2 for v in alloc.values())
+    assert alloc[0] >= alloc[1] == alloc[2] == 2
+    # remaining < min_runs: the clamp wins (budget ceilings are hard).
+    alloc = _allocate_lanes(
+        [0, 1], {0: 1.0, 1: 1.0}, {0: 1, 1: 64}, lanes=4, min_runs=2,
+    )
+    assert alloc[0] == 1 and alloc[1] >= 2
+
+
+def test_adaptive_layouts_do_not_grow_engine_cache():
+    """Adaptive rounds produce one-shot (config, count) layouts; caching
+    their stacked params in the session-lived engine cache would leak —
+    they go in a per-call cache instead (run_grid's static layouts still
+    share the engine cache)."""
+    pts = _grid(runs=32, batch=16)[:2]
+    before = {k for k in CACHE if isinstance(k, tuple)
+              and k and k[0] == "packed_params"}
+    run_grid_adaptive(
+        pts, ci_target_stat="blocks_found", ci_target_rel=2.0,
+        lanes=16, engine_cache=CACHE,
+    )
+    after = {k for k in CACHE if isinstance(k, tuple)
+             and k and k[0] == "packed_params"}
+    assert after == before
+
+
+def test_adaptive_converges_and_stops(tmp_path):
+    """A reachable target stops the loop early with converged points, and
+    the budget ceiling (config.runs) is never exceeded."""
+    pts = _grid(runs=32, batch=16)[:2]
+    out = run_grid_adaptive(
+        pts, ci_target_stat="blocks_found", ci_target_rel=2.0,
+        lanes=16, engine_cache=CACHE,
+    )
+    for entry in out:
+        assert entry["converged"] is True
+        assert entry["rounds"] <= 2
+    with pytest.raises(ValueError, match="unknown ci_target_stat"):
+        run_grid_adaptive(pts, ci_target_stat="nope")
+
+
+# ---------------------------------------------------------------------------
+# Dashboards: segment-aware stats spans.
+
+
+def test_watch_and_report_render_per_point_panels(tmp_path):
+    from tpusim.report import render_report
+    from tpusim.watch import render_watch
+
+    tele = tmp_path / "packed.tele.jsonl"
+    run_sweep(_grid()[:2], quiet=True, packed=True, engine_cache=CACHE,
+              telemetry_path=tele)
+    spans = load_spans(tele)
+    # The packed sweep owns the closing "run" span (watch exits on it).
+    assert any(s["span"] == "run" for s in spans)
+    rows = point_snapshot_rows([s for s in spans if s["span"] == "stats"])
+    assert [r[0] for r in rows] == [n for n, _ in _grid()[:2]]
+    watch = render_watch(spans, "t")
+    report = render_report(spans)
+    for txt in (watch, report):
+        assert "by grid point" in txt
+        for name, _ in _grid()[:2]:
+            assert name in txt
+    # A plain (non-packed) ledger has no point attrs: both dashboards fall
+    # back to the blended table.
+    assert point_snapshot_rows(
+        [{"span": "stats", "attrs": {"runs": 4}}]
+    ) is None
+
+
+def test_mixed_sweep_dashboards_render_both_tables():
+    """A MIXED packed sweep's ledger carries per-point segment spans AND
+    plain spans from unpackable fallback points — both dashboards must
+    render both tables (the fallback points' narrowing must not vanish
+    behind the per-point panel). Synthetic spans: no compute."""
+    from tpusim.report import render_report
+    from tpusim.watch import render_watch
+
+    stats = {"blocks_share": {"rel_hw_max": 0.02, "hw_max": 0.01}}
+    spans = [
+        {"span": "stats", "run_id": "r", "t": 1.0,
+         "attrs": {"point": "packed-pt", "runs": 8, "runs_done": 8,
+                   "runs_total": 8, "packed": True, "stats": stats}},
+        {"span": "stats", "run_id": "r", "t": 2.0,
+         "attrs": {"runs": 4, "runs_done": 4, "runs_total": 8,
+                   "stats": stats}},
+    ]
+    watch, report = render_watch(spans, "t"), render_report(spans)
+    for txt in (watch, report):
+        assert "by grid point" in txt and "packed-pt" in txt
+    assert "convergence (95% CI" in watch
+    assert "Convergence (stats spans)" in report
+
+
+# ---------------------------------------------------------------------------
+# Fleet: packed sub-grid units.
+
+
+def test_fleet_packed_units_dispatch_and_flush_in_order(tmp_path):
+    """The supervisor plans packed sub-grid units (fake grid worker), rows
+    land per-point in point order, and a crashed unit requeues WHOLE."""
+    from test_fleet import fake_cmd, fake_points, make_sup, rows_of
+
+    behaviors: dict[str, str] = {}
+    base_cmd = fake_cmd(behaviors)
+
+    def cmd(asg):
+        argv = base_cmd(asg)
+        if asg.get("grid_manifest") is not None:
+            argv += ["--grid", str(asg["grid_manifest"])]
+        return argv
+
+    pts = fake_points("pt-a", "pt-b", "pt-c")
+    sup = make_sup(tmp_path, pts, worker_cmd=cmd, workers=2, packed=True)
+    summary = sup.run()
+    # ceil(3/2)=2 -> one grid unit of 2 points + one plain point.
+    assert len(sup._units) == 1
+    unit, members = next(iter(sup._units.items()))
+    assert unit.startswith("grid-") and members == ["pt-a", "pt-b"]
+    manifest = json.loads(
+        (sup.state_dir / "points" / f"{unit}.grid.json").read_text()
+    )
+    assert [e["point"] for e in manifest["points"]] == members
+    assert summary["quarantined"] == []
+    assert [r["point"] for r in rows_of(sup)] == ["pt-a", "pt-b", "pt-c"]
+
+    # A unit whose worker dies requeues as a UNIT and heals whole.
+    behaviors2 = {}
+
+    def cmd2(asg):
+        argv = base_cmd(asg)
+        if asg.get("grid_manifest") is not None:
+            argv[argv.index("--behavior") + 1] = (
+                "fail" if asg["attempt"] == 0 else "ok"
+            )
+            argv += ["--grid", str(asg["grid_manifest"])]
+        return argv
+
+    sup2 = make_sup(tmp_path / "g2", fake_points("pt-a", "pt-b", "pt-c"),
+                    worker_cmd=cmd2, workers=2, packed=True)
+    summary2 = sup2.run()
+    assert summary2["requeues"] == 1 and summary2["quarantined"] == []
+    assert [r["point"] for r in rows_of(sup2)] == ["pt-a", "pt-b", "pt-c"]
+    healed = [r for r in rows_of(sup2) if r["point"] in ("pt-a", "pt-b")]
+    assert all(r["attempt"] == 1 for r in healed)
+
+
+def test_fleet_worker_chaos_targets_packed_unit_members():
+    """A chaos plan aimed at a point name must arm the packed sub-grid UNIT
+    that carries the point (units spawn under synthetic grid-… names)."""
+    from tpusim.fleet import FleetSupervisor
+
+    sup = object.__new__(FleetSupervisor)
+    plan = object()
+    sup._units = {"grid-abc": ["pt-a", "pt-b"]}
+    sup.worker_chaos, sup.worker_chaos_point = plan, "pt-b"
+    assert FleetSupervisor._worker_plan(sup, "grid-abc", 0) is plan
+    assert FleetSupervisor._worker_plan(sup, "pt-b", 0) is plan
+    assert FleetSupervisor._worker_plan(sup, "pt-c", 0) is None
+    assert FleetSupervisor._worker_plan(sup, "grid-abc", 1) is None
+    sup.worker_chaos, sup.worker_chaos_point = {"pt-b": plan}, None
+    assert FleetSupervisor._worker_plan(sup, "grid-abc", 0) is plan
+    assert FleetSupervisor._worker_plan(sup, "pt-a", 0) is None
+
+
+def test_fleet_worker_main_grid_manifest(tmp_path):
+    """The REAL packed grid worker: one worker_main --grid call runs the
+    whole sub-grid via run_sweep(packed=True) and publishes every member
+    row (exact sweep schema) in one atomic result object."""
+    from tpusim.fleet import worker_main
+
+    pts = _grid(runs=4, batch=4)[:2]
+    pdir = tmp_path / "points"
+    pdir.mkdir()
+    for name, cfg in pts:
+        (pdir / f"{name}.json").write_text(cfg.to_json())
+    manifest = tmp_path / "unit.grid.json"
+    manifest.write_text(json.dumps({
+        "unit": "grid-test",
+        "points": [
+            {"point": n, "config": str(pdir / f"{n}.json")} for n, _ in pts
+        ],
+    }))
+    result = tmp_path / "result.json"
+    rc = worker_main([
+        "--grid", str(manifest), "--result", str(result),
+        "--heartbeat", str(tmp_path / "beat.jsonl"),
+    ])
+    assert rc == 0
+    payload = json.loads(result.read_text())
+    rows = payload["rows"]
+    assert [r["point"] for r in rows] == [n for n, _ in pts]
+    ref = run_sweep(pts, quiet=True, engine_cache=CACHE)
+    assert _strip(rows) == _strip(ref)
+    with pytest.raises(SystemExit):
+        worker_main(["--result", "r", "--heartbeat", "h"])  # neither mode

@@ -408,6 +408,33 @@ def test_perf_run_compare_report_end_to_end(tmp_path):
     assert cli_main(["perf", "report", str(out)]) == 0
 
 
+def test_perf_run_engine_pin_excludes_sweep_scenario(tmp_path, monkeypatch, capsys):
+    """run_sweep_protocol always measures the auto-selected engine pair, so
+    a pinned --engine must never mislabel its ledger rows: the default
+    scenario set silently drops packed_sweep (with a notice), an explicit
+    --scenarios request fails loud, and --engine auto still runs it."""
+    calls = []
+    monkeypatch.setattr(
+        perf, "run_protocol", lambda **kw: calls.append(("chained", kw)) or []
+    )
+    monkeypatch.setattr(
+        perf, "run_sweep_protocol",
+        lambda **kw: calls.append(("sweep", kw)) or [],
+    )
+    out = tmp_path / "perf.jsonl"
+    rc = perf.main(["run", "--quick", "--engine", "scan", "--out", str(out)])
+    assert rc == 0
+    assert [c[0] for c in calls] == ["chained"]
+    assert "skipping packed_sweep" in capsys.readouterr().out
+    with pytest.raises(SystemExit) as ei:
+        perf.main(["run", "--engine", "scan", "--scenarios", "packed_sweep",
+                   "--out", str(out)])
+    assert ei.value.code == 2
+    calls.clear()
+    assert perf.main(["run", "--quick", "--out", str(out)]) == 0
+    assert [c[0] for c in calls] == ["chained", "sweep"]
+
+
 def test_committed_calibration_baseline_is_valid():
     """The baseline ci.sh gates against must stay schema-valid and carry
     both canonical scenarios at the quick shape."""
@@ -424,7 +451,21 @@ def test_committed_calibration_baseline_is_valid():
     assert ("chained_fast_yearlong", "s_per_chunk") in latest
     yl = latest[("chained_fast_yearlong", "s_per_chunk")]
     assert yl["shape"]["state_dtype"] == "int16" and yl["shape"]["count_rebase"]
+    # The grid-packing pair (PR-11 scenario) gates packed dispatch: the
+    # packed row must keep its sequential before-twin so the speedup claim
+    # stays anchored, and both must be at the quick sweep shape.
+    assert ("sweep_sequential", "points_per_s") in latest
+    assert ("sweep_packed", "points_per_s") in latest
+    sweep_quick = perf.SWEEP_PROTOCOL["quick"]
+    n_points = len(sweep_quick["intervals"]) * len(sweep_quick["pcts"])
     for row in latest.values():
         assert row["env"]["platform"] == "cpu"
-        assert row["shape"]["runs"] == perf.PROTOCOL["quick"]["runs"]
-        assert len(row["samples"]) == perf.PROTOCOL["quick"]["repeats"]
+        if row["scenario"].startswith("sweep_"):
+            assert row["better"] == "higher"
+            assert row["shape"]["points"] == n_points
+            assert row["shape"]["runs_per_point"] == sweep_quick["runs"]
+            assert row["shape"]["packed"] == (row["scenario"] == "sweep_packed")
+            assert len(row["samples"]) == sweep_quick["repeats"]
+        else:
+            assert row["shape"]["runs"] == perf.PROTOCOL["quick"]["runs"]
+            assert len(row["samples"]) == perf.PROTOCOL["quick"]["repeats"]

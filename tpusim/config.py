@@ -312,6 +312,34 @@ class SimConfig:
         mu = duration_ms / (self.network.block_interval_s * 1000.0)
         return int(2.0 * (mu + 8.0 * math.sqrt(mu + 1.0))) + 16
 
+    @property
+    def resolved_chunk_steps(self) -> int:
+        """The chunk-step budget the engine runs at — part of the sampling
+        identity (and of checkpoint fingerprints), so it has ONE source,
+        jax-free: ``Engine.__init__`` assigns from here and the packed shape
+        key (``tpusim.packed.pack_shape_key``) groups points with it without
+        building an engine. Default sizing: one TIME_CAP window's MEAN event
+        count (~2.05 events per block: find + arrival flush + same-ms
+        slack), NOT a tail bound — a run that exhausts its steps before the
+        cap resumes next chunk (undershoot costs one more loop iteration),
+        while every step past a run's cap is burned on a frozen run, so an
+        8-sigma bound wasted ~40% of all scan steps. The 4096 clamp keeps
+        short-interval configs from materializing huge (steps, 2, runs)
+        per-chunk RNG buffers. Both paths clamp against the *64-aligned*
+        event bound: an explicit chunk_steps pinned by
+        ``PallasEngine.scan_twin()`` — an already-aligned auto value
+        possibly above the raw bound — must resolve to itself, not re-clamp
+        to a different identity."""
+        bound = self._event_bound(self.duration_ms)
+        mu_w = min(TIME_CAP_MS, self.duration_ms) / (
+            self.network.block_interval_s * 1000.0
+        )
+        cap_mean = int(2.05 * mu_w) + 16
+        align = lambda v: (v + 63) // 64 * 64
+        if self.chunk_steps is None:
+            return min(align(min(cap_mean, 4096)), align(bound))
+        return min(self.chunk_steps, align(bound))
+
     def _divergence_allowance(self) -> int:
         """Bound on the count residual a per-chunk re-base can leave behind:
         blocks of one owner above the run's deepest common prefix. Two

@@ -57,6 +57,7 @@ __all__ = [
     "format_num",
     "format_eta",
     "snapshot_rows",
+    "point_snapshot_rows",
 ]
 
 #: Two-sided 95 % normal critical value (the CI the dashboards quote).
@@ -175,6 +176,50 @@ def snapshot_rows(per_stat: dict[str, Any]) -> list[list[str]]:
             format_num(hw_max),
             format_eta(entry.get("eta_runs"), entry.get("eta_s")),
         ])
+    return rows
+
+
+def point_snapshot_rows(stats_spans: list[dict]) -> list[list[str]] | None:
+    """Per-POINT convergence rows from segment-aware ``stats`` spans — the
+    packed-sweep spans (tpusim.packed) that carry a ``point`` attr naming
+    their grid segment. One row per point from its NEWEST span:
+    ``[point, runs, worst rel hw across stats, status]``. Returns None when
+    no span names a point (a plain single-run ledger), so both dashboards
+    fall back to the blended table. THE shared extraction behind the
+    ``tpusim watch`` packed panel and the report twin, tolerant of
+    foreign/partial entries like every other ledger consumer."""
+    latest: dict[str, dict] = {}
+    order: list[str] = []
+    for sp in stats_spans:
+        attrs = sp.get("attrs") or {}
+        pt = attrs.get("point")
+        if not isinstance(pt, str):
+            continue
+        if pt not in latest:
+            order.append(pt)
+        latest[pt] = attrs
+    if not latest:
+        return None
+    rows = []
+    for pt in order:
+        a = latest[pt]
+        per_stat = a.get("stats") or {}
+        rels = [
+            e.get("rel_hw_max") for e in per_stat.values()
+            if isinstance(e, dict)
+        ]
+        rels = [r for r in rels if isinstance(r, (int, float))]
+        conv = a.get("converged")
+        if conv is True:
+            status = "converged"
+        elif conv is False:
+            status = f"round {a.get('round', '?')}, {a.get('lanes', '?')} lanes"
+        else:
+            status = "done"
+        done = a.get("runs_done", a.get("runs"))
+        total = a.get("runs_total")
+        runs = f"{done}/{total}" if total else str(done)
+        rows.append([pt, runs, format_num(max(rels) if rels else None), status])
     return rows
 
 

@@ -222,16 +222,24 @@ def combine_sums(a: dict[str, np.ndarray], b: dict[str, np.ndarray]) -> dict[str
     stat sums, elementwise max for the ``*_max`` telemetry keys (a batch's
     busy-chunk count / deepest reorg is the max over its runs, and run
     behavior is batching-invariant under the counter-based RNG), and
-    run-axis concatenation for the per-run flight-recorder arrays.
+    run-axis concatenation for the per-run arrays — the flight-recorder
+    keys and, since grid packing (tpusim.packed), any ``*_per_run`` leaf:
+    a packed engine's raw outputs keep the runs (= segment) axis intact, so
+    splitting a packed batch and re-concatenating is bit-equal to one
+    dispatch and the per-point segment reduction downstream never sees the
+    split (pinned by tests/test_packed_sweep.py).
 
     The streaming-moment keys (``stats_n``, ``stats_<stat>_m1/m2`` —
     tpusim.convergence) ride the additive branch deliberately: they are
     int64 fixed-point sums, so this merge is exact, hence associative and
     permutation-invariant bit-for-bit — the property that keeps the
     convergence estimator identical across batch splits and the pallas
-    head/tail split (pinned by tests/test_convergence.py)."""
+    head/tail split (pinned by tests/test_convergence.py). Per-POINT
+    segment leaves (a leading points axis over additive sums, the packed
+    sweep's device segment reduction) ride it too: integer segment sums
+    over disjoint run sets merge exactly, whatever the split."""
     def merge(k):
-        if k.startswith("flight_"):
+        if k.startswith("flight_") or k.endswith("_per_run"):
             return np.concatenate([np.asarray(a[k]), np.asarray(b[k])])
         if k.endswith(_MAX_KEYS_SUFFIX):
             return np.maximum(a[k], b[k])
@@ -440,7 +448,40 @@ class Engine:
     join, SURVEY.md section 2.2).
     """
 
-    def __init__(self, config: SimConfig, mesh: Mesh | None = None):
+    def __init__(
+        self, config: SimConfig, mesh: Mesh | None = None, *,
+        packed: bool = False,
+    ):
+        """``packed`` (tpusim.packed — device-side grid packing) makes the
+        scenario parameters per-RUN runtime tensors: every ``SimParams``
+        leaf gains a leading runs axis (stacked by the packed dispatcher),
+        the per-run duration ledger initializes from :attr:`run_durations`,
+        and :meth:`run_batch` returns RAW per-run leaves (no batch-global
+        host reduction) so the dispatcher can segment-reduce them per grid
+        point. The per-run compute is identical — vmap slices each run the
+        same params it would have received broadcast — so results are
+        bit-equal to a sequential per-point sweep (pinned by
+        tests/test_packed_sweep.py). Packed engines require
+        ``rng="threefry"`` (the counter-based draws whose interval mapping
+        is pure float32) and run unsharded (mesh packing rides the
+        next-TPU-window checklist with the rest of SPMD)."""
+        if packed:
+            if mesh is not None:
+                raise ValueError(
+                    "packed engines run unsharded; mesh grid packing rides "
+                    "the next TPU window (ROADMAP)"
+                )
+            if config.rng != "threefry":
+                raise ValueError(
+                    "packed engines need rng='threefry' (per-run params with "
+                    "the float32 interval mapping); xoroshiro grids run "
+                    "sequentially"
+                )
+        self.packed = packed
+        #: Per-run int64 duration_ms array (packed mode only; None keeps the
+        #: config-scalar ledger). Set by the packed dispatcher BEFORE the
+        #: first dispatch of each packed batch — a runtime input like keys.
+        self.run_durations: np.ndarray | None = None
         self.config = config
         self.mesh = mesh
         # Fault-injection seam (tpusim.chaos): host-side only, never traced —
@@ -459,28 +500,11 @@ class Engine:
         self.exact = config.resolved_mode == "exact"
         self.any_selfish = config.network.any_selfish
         bound = default_n_steps(config.duration_ms, config.network.block_interval_s)
-        # Default chunk_steps: one TIME_CAP window's MEAN event count (~2.05
-        # events per block: find + arrival flush + same-ms slack), NOT a tail
-        # bound. A run that exhausts its steps before reaching the cap simply
-        # resumes next chunk (undershoot costs one more loop iteration and a
-        # ~0.1 ms threefry), while every step past a run's cap is burned on a
-        # frozen run — so sizing to an 8-sigma bound wasted ~40% of all scan
-        # steps. The 4096 clamp keeps short-interval configs from
-        # materializing huge (steps, 2, runs) per-chunk RNG buffers.
-        mu_w = min(int(TIME_CAP), config.duration_ms) / (
-            config.network.block_interval_s * 1000.0
-        )
-        cap_mean = int(2.05 * mu_w) + 16
-        # Both paths clamp against the *64-aligned* bound: the resolved value
-        # is part of the sampling identity (and of checkpoint fingerprints),
-        # so an explicit chunk_steps pinned by PallasEngine.scan_twin() — an
-        # already-aligned auto value possibly above the raw bound — must
-        # resolve to itself here, not re-clamp to a different identity.
-        align = lambda v: (v + 63) // 64 * 64
-        if config.chunk_steps is None:
-            self.chunk_steps = min(align(min(cap_mean, 4096)), align(bound))
-        else:
-            self.chunk_steps = min(config.chunk_steps, align(bound))
+        # The chunk budget is sampling identity, so its resolution lives in
+        # ONE jax-free place — SimConfig.resolved_chunk_steps (sizing
+        # rationale there) — shared with the packed shape key that groups
+        # grid points without building an engine.
+        self.chunk_steps = config.resolved_chunk_steps
         # Host-loop safety margin: generous vs the per-run 8-sigma bound
         # because the loop must cover the batch *max* event count; the second
         # term covers runs that freeze at TIME_CAP and re-base repeatedly.
@@ -701,6 +725,20 @@ class Engine:
             # re-add boundary where the re-based counts become absolute
             # again, so every output below is bit-identical either way.
             per_run = jax.vmap(final_stats)(state, t_end, cbase)
+            if packed:
+                # Packed grids: NOTHING is reduced over the runs axis on
+                # device — a batch mixes grid points, so every leaf keeps
+                # its runs (= segment) axis and the dispatcher reduces per
+                # point on the host with the exact reductions the
+                # sequential path applies per batch (tpusim.packed).
+                return {
+                    "blocks_found_per_run": per_run["blocks_found"],
+                    "stale_blocks_per_run": per_run["stale_blocks"],
+                    "best_height_per_run": per_run["best_height"],
+                    "overflow_per_run": per_run["overflow"],
+                    "blocks_share_per_run": per_run["blocks_share"],
+                    "stale_rate_per_run": per_run["stale_rate"],
+                }
             return {
                 "blocks_found_sum": jnp.sum(per_run["blocks_found"], axis=0),
                 "stale_blocks_sum": jnp.sum(per_run["stale_blocks"], axis=0),
@@ -721,8 +759,11 @@ class Engine:
                 "blocks_found_per_run": per_run["blocks_found"],
             }
 
-        vinit = jax.vmap(init_fn, in_axes=(0, None))
-        vchunk = jax.vmap(chunk_fn, in_axes=(0, 0, 0, 0, None, None))
+        # Packed grids vmap the params leaves over the runs axis (each run
+        # carries its grid point's roster/interval); broadcast otherwise.
+        pax = 0 if packed else None
+        vinit = jax.vmap(init_fn, in_axes=(0, pax))
+        vchunk = jax.vmap(chunk_fn, in_axes=(0, 0, 0, 0, None, pax))
         self._init_impl = vinit
         self._chunk_impl = vchunk
         self._finalize_impl = finalize_fn
@@ -894,7 +935,7 @@ class Engine:
             self.exact, self.any_selfish, self.chunk_steps, self.superstep,
             self.max_chunks, c.rng, c.flight_capacity, c.rng_batch,
             c.resolved_count_dtype, c.consensus_gather, c.count_rebase,
-            mesh_id,
+            self.packed, mesh_id,
         )
 
     def rebind(self, config: SimConfig, key: tuple) -> "Engine":
@@ -942,11 +983,26 @@ class Engine:
     _LEDGER_BASE = 1 << 30
 
     def _ledger_init(self, n: int) -> tuple[jax.Array, jax.Array]:
-        """Split ``duration_ms`` into the per-run (hi, lo) int32 ledger pair."""
-        dur = int(self.config.duration_ms)
+        """Split ``duration_ms`` into the per-run (hi, lo) int32 ledger pair.
+        The ledger was per-run from the start, so ragged packed horizons
+        (``run_durations``) cost nothing: each run simply starts with its own
+        remaining-time budget and freezes when it runs out — the "duration
+        mask" of the packed dispatcher is this pair."""
         shift = self._LEDGER_BASE.bit_length() - 1
+        mask = self._LEDGER_BASE - 1
+        if self.run_durations is not None:
+            dur = np.asarray(self.run_durations, dtype=np.int64)
+            if dur.shape != (n,):
+                raise ValueError(
+                    f"run_durations shape {dur.shape} != batch ({n},)"
+                )
+            return (
+                jnp.asarray((dur >> shift).astype(np.int32)),
+                jnp.asarray((dur & mask).astype(np.int32)),
+            )
+        dur = int(self.config.duration_ms)
         hi = jnp.full((n,), dur >> shift, jnp.int32)
-        lo = jnp.full((n,), dur & (self._LEDGER_BASE - 1), jnp.int32)
+        lo = jnp.full((n,), dur & mask, jnp.int32)
         return hi, lo
 
     def _device_loop(self, keys: jax.Array, hi0: jax.Array, lo0: jax.Array,
@@ -1082,14 +1138,21 @@ class Engine:
         sums = self._finalize(state, t_end, aux[-1])
         # tpusim-lint: disable=JX002 -- batch-end stat transfer, once per
         # batch, after the dispatch loop has fully drained.
-        out = _host_reduce_sums({k: np.asarray(v) for k, v in sums.items()})
+        out = {k: np.asarray(v) for k, v in sums.items()}
+        if not self.packed:
+            out = _host_reduce_sums(out)
         dev_sums: dict = {}
         self._aux_to_sums(aux, dev_sums)
         # tpusim-lint: disable=JX002 -- same batch-end transfer as above: the
         # aux counters (and flight ring, if recording) come down once per
         # batch, after the dispatch loop has fully drained.
         out.update({k: np.asarray(v) for k, v in dev_sums.items()})
-        _host_reduce_telemetry(out, popped)
+        if self.packed:
+            # Raw per-run leaves: the packed dispatcher segment-reduces them
+            # per grid point; only the busy-chunk count is batch-scoped.
+            out["tele_chunks_max"] = np.int64(popped)
+        else:
+            _host_reduce_telemetry(out, popped)
         out["runs"] = np.int64(n)
         return out
 
@@ -1123,6 +1186,19 @@ class Engine:
             )
 
     def _batch_guard(self, n: int) -> None:
+        if self.run_durations is not None:
+            # Packed batch: per-run durations and per-run mean intervals —
+            # the bound is the sum of each run's expected block count.
+            mi = np.asarray(self.params.mean_interval_ms, dtype=np.float64)
+            dur = np.asarray(self.run_durations, dtype=np.float64)
+            blocks_bound = float(np.sum(dur / np.maximum(mi, 1.0))) * 1.1
+            if blocks_bound > _I32_SUM_GUARD:
+                raise ValueError(
+                    f"packed batch of {n} runs overflows int32 block-count "
+                    f"sums ({blocks_bound:.3g} expected blocks); lower the "
+                    f"pack width"
+                )
+            return
         duration = self.config.duration_ms
         blocks_bound = n * (duration / (self.config.network.block_interval_s * 1000.0)) * 1.1
         if blocks_bound > _I32_SUM_GUARD:
@@ -1195,7 +1271,9 @@ class Engine:
         def finalize() -> dict[str, np.ndarray]:
             # tpusim-lint: disable=JX002 -- THE deliberate sync point: the
             # whole contract of run_batch_async is that this callable blocks.
-            out = _host_reduce_sums({k: np.asarray(v) for k, v in sums.items()})
+            out = {k: np.asarray(v) for k, v in sums.items()}
+            if not self.packed:
+                out = _host_reduce_sums(out)
             n_chunks = int(out.pop("n_chunks"))
             if out.pop("unfinished"):
                 raise RuntimeError(
@@ -1205,7 +1283,10 @@ class Engine:
                 )
             # n_chunks is already the busy-chunk count: the while cond admits
             # only chunks with >= 1 unfinished run (pmax across mesh shards).
-            _host_reduce_telemetry(out, n_chunks)
+            if self.packed:
+                out["tele_chunks_max"] = np.int64(n_chunks)
+            else:
+                _host_reduce_telemetry(out, n_chunks)
             out["runs"] = np.int64(n)
             return out
 
@@ -1261,7 +1342,14 @@ class Engine:
         state, aux = self._init(keys, self.params)
         # Multi-process: non-local entries stay at `duration` forever (their
         # processes own them); only local indices are read or updated.
-        remaining = np.full((n,), duration, dtype=np.int64)
+        if self.run_durations is not None:
+            remaining = np.asarray(self.run_durations, dtype=np.int64).copy()
+            if remaining.shape != (n,):
+                raise ValueError(
+                    f"run_durations shape {remaining.shape} != batch ({n},)"
+                )
+        else:
+            remaining = np.full((n,), duration, dtype=np.int64)
         time_cap = np.int64(int(TIME_CAP))
 
         for chunk_idx in range(self.max_chunks):
@@ -1282,7 +1370,9 @@ class Engine:
         sums = self._finalize(state, t_end, aux[-1])
         # tpusim-lint: disable=JX002 -- batch-end stat transfer (see
         # _run_batch_pipelined); the loop above has already terminated.
-        out = _host_reduce_sums({k: np.asarray(v) for k, v in sums.items()})
+        out = {k: np.asarray(v) for k, v in sums.items()}
+        if not self.packed:
+            out = _host_reduce_sums(out)
         if multiproc:
             # Non-addressable shards: telemetry reduces over this process's
             # local runs only (the stat sums above are still global psums).
@@ -1305,6 +1395,9 @@ class Engine:
         out.update({k: fetch(v) for k, v in dev_sums.items()})
         # Every executed chunk had >= 1 active run (the loop breaks the
         # moment all_done flips), so chunk_idx + 1 IS the busy-chunk count.
-        _host_reduce_telemetry(out, chunk_idx + 1)
+        if self.packed:
+            out["tele_chunks_max"] = np.int64(chunk_idx + 1)
+        else:
+            _host_reduce_telemetry(out, chunk_idx + 1)
         out["runs"] = np.int64(n)
         return out

@@ -217,48 +217,79 @@ def worker_main(argv: list[str] | None = None) -> int:
     :data:`WORKER_CHAOS_ENV` is armed across every runner seam (that is how
     the kill/hang/ENOSPC drills reach the worker)."""
     p = argparse.ArgumentParser(prog="tpusim fleet --worker")
-    p.add_argument("--point", required=True)
-    p.add_argument("--config", required=True, type=Path)
+    p.add_argument("--point", default=None)
+    p.add_argument("--config", type=Path, default=None)
+    p.add_argument(
+        "--grid", type=Path, default=None,
+        help="packed sub-grid manifest JSON ({'unit', 'points': [{'point', "
+        "'config'}]}): run the whole sub-grid as packed device programs "
+        "(tpusim.packed) and publish ALL its rows in one result object",
+    )
     p.add_argument("--result", required=True, type=Path)
     p.add_argument("--heartbeat", required=True, type=Path)
-    p.add_argument("--checkpoint", required=True, type=Path)
+    p.add_argument("--checkpoint", type=Path, default=None)
     p.add_argument("--heartbeat-s", type=float, default=1.0)
     p.add_argument("--single-device", action="store_true")
     p.add_argument("--telemetry", type=Path, default=None)
     args = p.parse_args(argv)
+    if (args.grid is None) == (args.point is None):
+        p.error("exactly one of --point/--config or --grid is required")
+    if args.point is not None and args.config is None:
+        p.error("--point needs --config")
 
     plan_text = os.environ.get(WORKER_CHAOS_ENV)
     injector = ChaosInjector(ChaosPlan.from_json(plan_text)) if plan_text else None
-    config = SimConfig.from_json(args.config.read_text())
     hb = _Heartbeat(args.heartbeat, args.heartbeat_s, chaos=injector)
     hb.start()  # first beat BEFORE the jax import: the lease covers startup
 
-    from .runner import run_simulation_config
-
-    recorder = TelemetryRecorder(args.telemetry) if args.telemetry else None
     t0 = time.monotonic()
-    try:
-        res = run_simulation_config(
-            config,
-            use_all_devices=not args.single_device,
+    if args.grid is not None:
+        # Packed sub-grid worker: one run_sweep(packed=True) over the
+        # manifest's points — the whole sub-grid as one (or a few) compiled
+        # device dispatches, every row in one atomically-published object.
+        # run_sweep owns the telemetry recorder for this path.
+        manifest = json.loads(args.grid.read_text())
+        points = [
+            (entry["point"], SimConfig.from_json(Path(entry["config"]).read_text()))
+            for entry in manifest["points"]
+        ]
+        from .sweep import run_sweep
+
+        rows = run_sweep(
+            points, quiet=True, packed=True, chaos=injector,
+            telemetry_path=args.telemetry, engine_cache={},
             progress=hb.progress,
-            checkpoint_path=args.checkpoint,
-            telemetry=recorder,
-            chaos=injector,
+            use_all_devices=not args.single_device,
         )
-    finally:
-        if recorder is not None:
-            recorder.close()
-    # The exact run_sweep row schema (same key order), so fleet output diffs
-    # clean against a single-process sweep of the same grid.
-    row = {
-        **res.to_dict(),
-        "point": args.point,
-        "backend": "tpu",
-        "elapsed_s": round(time.monotonic() - t0, 3),
-    }
+        payload: dict = {"rows": rows}
+    else:
+        recorder = TelemetryRecorder(args.telemetry) if args.telemetry else None
+        config = SimConfig.from_json(args.config.read_text())
+
+        from .runner import run_simulation_config
+
+        try:
+            res = run_simulation_config(
+                config,
+                use_all_devices=not args.single_device,
+                progress=hb.progress,
+                checkpoint_path=args.checkpoint,
+                telemetry=recorder,
+                chaos=injector,
+            )
+        finally:
+            if recorder is not None:
+                recorder.close()
+        # The exact run_sweep row schema (same key order), so fleet output
+        # diffs clean against a single-process sweep of the same grid.
+        payload = {
+            **res.to_dict(),
+            "point": args.point,
+            "backend": "tpu",
+            "elapsed_s": round(time.monotonic() - t0, 3),
+        }
     tmp = args.result.with_name(args.result.name + ".tmp")
-    tmp.write_text(json.dumps(row))
+    tmp.write_text(json.dumps(payload))
     os.replace(tmp, args.result)  # atomic publish: the supervisor never
     hb.stop()                     # reads a half-written row
     return 0
@@ -354,6 +385,8 @@ class FleetSupervisor:
         quiet: bool = False,
         single_device: bool = False,
         telemetry_path: str | Path | None = None,
+        packed: bool = False,
+        grid_size: int | None = None,
         chaos=None,
         worker_chaos=None,
         worker_chaos_point: str | None = None,
@@ -377,6 +410,15 @@ class FleetSupervisor:
         self.resume = resume
         self.quiet = quiet
         self.single_device = single_device
+        #: Packed sub-grid dispatch (tpusim.packed): workers receive WHOLE
+        #: sub-grids of shape-agreeing points (one packed device program per
+        #: worker) instead of single points. ``grid_size`` caps the points
+        #: per sub-grid (default: spread each shape group across the worker
+        #: count). Leases/requeues/quarantine then operate at sub-grid
+        #: granularity; output rows keep per-point schema and order.
+        self.packed = packed
+        self.grid_size = grid_size
+        self._units: dict[str, list[str]] = {}
         self.chaos = as_injector(chaos)
         if isinstance(worker_chaos, (str, Path)):
             # Load ONCE, loud, at construction: a typo'd plan path deferred
@@ -443,15 +485,31 @@ class FleetSupervisor:
         reason sweep recovery resumes WITHOUT the plan."""
         if self.worker_chaos is None or attempt != 0:
             return None
+        # Packed sub-grid units spawn under a synthetic "grid-…" name, so
+        # point-targeted plans must match against the unit's MEMBERS (a plan
+        # aimed at pt-b arms the whole unit that carries pt-b).
+        members = self._unit_points(point)
         if isinstance(self.worker_chaos, dict):
-            return self.worker_chaos.get(point)
-        if self.worker_chaos_point is not None and point != self.worker_chaos_point:
+            for member in members:
+                plan = self.worker_chaos.get(member)
+                if plan is not None:
+                    return plan
+            return None
+        if (
+            self.worker_chaos_point is not None
+            and self.worker_chaos_point not in members
+        ):
             return None
         return self.worker_chaos
 
+    def _unit_points(self, unit: str) -> list[str]:
+        """The sweep points one work unit covers: the sub-grid members for a
+        packed grid unit, the point itself otherwise."""
+        return self._units.get(unit, [unit])
+
     def _assignment(self, point: str, attempt: int, wid: str) -> dict[str, Any]:
         workers_dir = self.state_dir / "workers"
-        return {
+        asg = {
             "point": point,
             "attempt": attempt,
             "worker": wid,
@@ -465,17 +523,36 @@ class FleetSupervisor:
                 if self.recorder is not None else None
             ),
         }
+        if point in self._units:
+            # Packed sub-grid unit: the worker receives a manifest naming
+            # every member point and its config file (written at startup).
+            manifest = self.state_dir / "points" / f"{point}.grid.json"
+            manifest.write_text(json.dumps({
+                "unit": point,
+                "points": [
+                    {"point": pt,
+                     "config": str(self.state_dir / "points" / f"{pt}.json")}
+                    for pt in self._units[point]
+                ],
+            }))
+            asg["grid_manifest"] = manifest
+        return asg
 
     def _default_worker_cmd(self, asg: dict[str, Any]) -> list[str]:
         argv = [
             sys.executable, "-m", "tpusim.fleet", "--worker",
-            "--point", asg["point"],
-            "--config", str(asg["config_path"]),
             "--result", str(asg["result_path"]),
             "--heartbeat", str(asg["heartbeat_path"]),
-            "--checkpoint", str(asg["checkpoint_path"]),
             "--heartbeat-s", str(self.heartbeat_s),
         ]
+        if asg.get("grid_manifest") is not None:
+            argv += ["--grid", str(asg["grid_manifest"])]
+        else:
+            argv += [
+                "--point", asg["point"],
+                "--config", str(asg["config_path"]),
+                "--checkpoint", str(asg["checkpoint_path"]),
+            ]
         if self.single_device:
             argv.append("--single-device")
         if asg["telemetry_path"] is not None:
@@ -621,22 +698,50 @@ class FleetSupervisor:
         self.live.remove(w)
         if rc == 0:
             try:
-                row = json.loads(w.row_path.read_text())
-                if not isinstance(row, dict):
+                payload = json.loads(w.row_path.read_text())
+                if not isinstance(payload, dict):
                     raise ValueError("result row is not an object")
+                if w.point in self._units:
+                    # Packed grid unit: the payload carries every member
+                    # row; a missing member is a worker failure, not a
+                    # silently half-done grid.
+                    rows = payload.get("rows")
+                    if not isinstance(rows, list):
+                        raise ValueError("grid result has no rows list")
+                    by_point = {
+                        r.get("point"): r for r in rows if isinstance(r, dict)
+                    }
+                    missing = [
+                        pt for pt in self._units[w.point] if pt not in by_point
+                    ]
+                    if missing:
+                        raise ValueError(f"grid rows missing points {missing}")
+                    rows_out = [by_point[pt] for pt in self._units[w.point]]
+                else:
+                    rows_out = [payload]
             except (OSError, ValueError) as e:
                 # Exit 0 with no publishable row is still a worker failure.
                 self._requeue(w.point, w.wid, f"bad_result:{type(e).__name__}")
                 return True
-            self._rows[w.point] = row
+            for row in rows_out:
+                self._rows[row["point"]] = row
             self.failures.pop(w.point, None)
+            done_runs = sum(int(r.get("runs") or 0) for r in rows_out)
+            # Sum the member rows: run_grid amortizes a pack's wall time
+            # over its points, so the last row alone would understate a
+            # sub-grid unit's duration by roughly the member count.
+            unit_elapsed = round(
+                sum(float(r.get("elapsed_s") or 0.0) for r in rows_out), 3
+            )
             self._log_event(
                 "done", point=w.point, worker=w.wid, attempt=w.attempt,
-                elapsed_s=row.get("elapsed_s"), runs=row.get("runs"),
+                elapsed_s=unit_elapsed, runs=done_runs,
+                points=len(rows_out),
             )
             self._emit(
                 "fleet_done", target=w.point, worker=w.wid, attempt=w.attempt,
-                elapsed_s=row.get("elapsed_s"), runs=row.get("runs"),
+                elapsed_s=unit_elapsed, runs=done_runs,
+                points=len(rows_out),
             )
             self._say(f"[fleet] {w.wid} finished {w.point}")
         else:
@@ -676,7 +781,9 @@ class FleetSupervisor:
         """Append buffered rows to ``out_path`` in POINT order (quarantined
         and previously-done points are skipped), so a fleet output file is
         line-for-line comparable with ``run_sweep``'s."""
-        quarantined = set(self.quarantined)
+        quarantined = {
+            pt for unit in self.quarantined for pt in self._unit_points(unit)
+        }
         while self._flush_idx < len(self._order):
             name = self._order[self._flush_idx]
             if name in self._done_prior or name in quarantined:
@@ -742,7 +849,8 @@ class FleetSupervisor:
             orphans = [ev for ev in state.values() if ev["event"] == "lease"]
 
         try:
-            for name, config in self.points:
+            remaining: list[int] = []
+            for i, (name, config) in enumerate(self.points):
                 if (name, config.runs, "tpu") in done_keys:
                     self._done_prior.add(name)
                     self._say(f"[fleet] {name} already in {self.out_path}; skipping")
@@ -750,7 +858,39 @@ class FleetSupervisor:
                 (self.state_dir / "points" / f"{name}.json").write_text(
                     config.to_json()
                 )
-                self._queue.append(name)
+                remaining.append(i)
+            if self.packed:
+                # Sub-grid units: shape-agreeing points grouped by the
+                # jax-free pack planner, each group chunked so the whole
+                # fleet's workers stay busy (or to --grid-size). Unit names
+                # are deterministic over their membership (crc32), so a
+                # resumed supervisor regenerates the same names for the
+                # same remaining set and orphan adoption keeps working.
+                from .packed import plan_packs
+
+                rem_points = [self.points[i] for i in remaining]
+                packs, sequential = plan_packs(rem_points)
+                size = self.grid_size or max(
+                    1, -(-len(rem_points) // self.workers)
+                )
+                for pack in packs:
+                    for lo in range(0, len(pack.indices), size):
+                        members = [
+                            rem_points[j][0]
+                            for j in pack.indices[lo:lo + size]
+                        ]
+                        if len(members) == 1:
+                            self._queue.append(members[0])
+                            continue
+                        crc = zlib.crc32("|".join(members).encode())
+                        unit = f"grid-{crc:08x}"
+                        self._units[unit] = members
+                        self._queue.append(unit)
+                for j in sequential:
+                    self._queue.append(rem_points[j][0])
+            else:
+                for i in remaining:
+                    self._queue.append(self.points[i][0])
             for ev in orphans:
                 if ev["point"] in self._queue:
                     # Orphaned lease from a dead supervisor: the point is
@@ -907,6 +1047,18 @@ def main(argv: list[str] | None = None) -> int:
         "--worker-chaos-point", default=None, metavar="NAME",
         help="restrict --worker-chaos to one named point",
     )
+    p.add_argument(
+        "--packed", action="store_true",
+        help="dispatch whole sub-grids per worker as packed device programs "
+        "(tpusim.packed) instead of single points; leases and quarantine "
+        "operate at sub-grid granularity (a requeued grid restarts whole — "
+        "packed units carry no per-point checkpoints)",
+    )
+    p.add_argument(
+        "--grid-size", type=int, default=None,
+        help="max points per packed sub-grid (default: spread each shape "
+        "group across --workers)",
+    )
     p.add_argument("--single-device", action="store_true")
     p.add_argument("--no-probe", action="store_true")
     p.add_argument("--quiet", action="store_true")
@@ -961,6 +1113,8 @@ def main(argv: list[str] | None = None) -> int:
         quiet=args.quiet,
         single_device=args.single_device,
         telemetry_path=args.telemetry,
+        packed=args.packed,
+        grid_size=args.grid_size,
         chaos=chaos,
         worker_chaos=args.worker_chaos,
         worker_chaos_point=args.worker_chaos_point,

@@ -1,0 +1,806 @@
+"""Device-side grid packing: run a whole sweep grid as ONE device program.
+
+``run_sweep`` holds one compiled engine across same-shape grid points
+(Engine.rebind, tests/test_sweep_engine_cache.py) but still dispatches the
+points *sequentially* — the device idles between points and every point pays
+a full dispatch round trip. This module packs an entire grid onto the runs
+axis of one compiled program instead (the accelerator-saturation trick of
+batched policy simulation, PAPERS.md arXiv:2406.01939, and the Ising-on-TPU
+recipe of one program over a lattice of configurations, arXiv:1903.11714):
+
+  * **Per-run scenario params.** Every ``SimParams`` leaf gains a leading
+    runs axis (:func:`stack_params`): roster thresholds, propagation delays,
+    selfish flags and the mean block interval become runtime tensors, vmapped
+    per run by ``Engine(packed=True)``. Ragged horizons need no mask at all —
+    the engines' remaining-time ledger was per-run from the start, so each
+    run simply carries its own point's ``duration_ms``.
+  * **Shape agreement.** Points pack together exactly when they would compile
+    the same program (:func:`pack_shape_key` — a jax-free conservative twin
+    of ``Engine.reuse_key``): same miner count, mode, resolved chunk budget,
+    rng and compile-time knobs. Points that disagree form separate packs;
+    ``rng="xoroshiro"`` and flight-recorder configs fall back to the
+    sequential path (documented in README "Grid packing").
+  * **Per-run -> per-point segment reduction.** A packed engine returns RAW
+    per-run leaves (``combine_sums`` concatenates them across any split);
+    :func:`_fold_piece` applies, per grid point, byte-for-byte the host
+    reductions the sequential path applies per batch — device-exact integer
+    sums, float64 ratio folds over the same values in the same order, the
+    exact int64 moment keys of tpusim.convergence, and the SimCounters
+    reductions — so every per-point output is BIT-equal to the sequential
+    sweep (pinned by tests/test_packed_sweep.py). Pieces are cut at each
+    point's own ``batch_size`` boundaries so even the float64 accumulation
+    order matches a sequential run.
+  * **int16 safety under packing.** A packed batch mixes rosters, so the
+    packed state dtype resolves from the WORST-CASE point
+    (:func:`packed_count_dtype`: max ``count_bound`` over the pack) — int16
+    only when every point provably fits, loud ``ValueError`` when a point
+    explicitly demands int16 the pack cannot honor.
+  * **Adaptive runs-per-point.** :func:`run_grid_adaptive` drives the
+    ``ci_target_stat`` convergence machinery inside the packed batch: each
+    round re-allocates the fixed lane budget toward the points with the
+    widest relative CI (converged points stop consuming lanes), at constant
+    dispatch width so the whole loop stays on one compiled program.
+
+Module import is jax-free (the fleet supervisor groups sub-grids with
+:func:`pack_shape_key` without initializing a backend); only the dispatch
+functions import the engines lazily.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Iterable
+
+import numpy as np
+
+from .config import SimConfig
+from .convergence import STATS as MOMENT_STATS
+from .convergence import MomentAccumulator, moment_keys
+from .stats import SimResults
+
+logger = logging.getLogger("tpusim")
+
+__all__ = [
+    "pack_shape_key",
+    "packable",
+    "packed_count_dtype",
+    "plan_packs",
+    "stack_params",
+    "run_grid",
+    "run_grid_adaptive",
+]
+
+
+def _resolved_chunk_steps(cfg: SimConfig) -> int:
+    """The sampling-identity chunk budget, single-sourced (jax-free) in
+    ``SimConfig.resolved_chunk_steps`` — ``Engine.__init__`` assigns from
+    the same property, and tests/test_packed_sweep.py pins the agreement
+    against engine drift."""
+    return cfg.resolved_chunk_steps
+
+
+def pack_shape_key(cfg: SimConfig) -> tuple:
+    """Hashable program-shape identity for grid packing, jax-free: two
+    configs with equal keys trace the same packed program (params and
+    durations are runtime inputs), so they may share one pack. Conservative
+    refinement of ``Engine.reuse_key``: it additionally pins the resolved
+    chunk budget (part of the sampling identity — packing must not change
+    any point's draws) but leaves the roster, interval, seed and duration
+    out (those are exactly what packing turns into runtime tensors). The
+    count dtype is deliberately NOT in the key: the pack resolves it from
+    the worst-case point (:func:`packed_count_dtype`)."""
+    return (
+        cfg.network.n_miners,
+        cfg.resolved_group_slots,
+        cfg.resolved_mode,
+        cfg.network.any_selfish,
+        cfg.rng,
+        cfg.flight_capacity,
+        cfg.rng_batch,
+        cfg.consensus_gather,
+        cfg.count_rebase,
+        cfg.superstep,
+        _resolved_chunk_steps(cfg),
+    )
+
+
+def packable(cfg: SimConfig) -> bool:
+    """Whether this point may enter a pack at all (the fallback rules the
+    README documents): packed engines need the counter-based threefry draws
+    (per-run params with the pure-float32 interval mapping) and no flight
+    recorder (per-run event rings are single-point tooling — ``tpusim
+    trace`` never packs)."""
+    return cfg.rng == "threefry" and cfg.flight_capacity == 0
+
+
+def packed_count_dtype(configs: Iterable[SimConfig]) -> str:
+    """The packed state dtype for one pack, resolved from the WORST-CASE
+    point: int16 only when the max ``count_bound`` over the pack fits (each
+    run only ever holds its own point's dynamics, so the per-point bounds
+    apply per run — but the COMPILED layout is shared, so one over-bound
+    point widens the whole pack). Explicit ``state_dtype`` requests are
+    honored fail-loud: "int32" anywhere forces int32; "int16" anywhere that
+    the worst case cannot honor raises instead of silently widening."""
+    configs = list(configs)
+    worst = max(c.count_bound for c in configs)
+    fits = worst <= 2**15 - 1
+    explicit16 = [c for c in configs if c.state_dtype == "int16"]
+    if any(c.state_dtype == "int32" for c in configs):
+        if explicit16:
+            raise ValueError(
+                "pack mixes explicit state_dtype='int16' and 'int32' points; "
+                "packed state is one shared layout — align the knobs or "
+                "run sequentially"
+            )
+        return "int32"
+    if explicit16 and not fits:
+        raise ValueError(
+            f"state_dtype='int16' requested but the pack's worst-case "
+            f"count_bound ({worst}) exceeds int16; a packed batch shares one "
+            f"state layout, so the widest point decides — use 'auto' (the "
+            f"pack widens to int32) or pack that point separately"
+        )
+    return "int16" if fits else "int32"
+
+
+@dataclasses.dataclass
+class _Pack:
+    """One shape-agreement group: the points (original indices kept for
+    output ordering) that run as one compiled device program."""
+
+    key: tuple
+    indices: list[int]
+
+
+def plan_packs(
+    points: list[tuple[str, SimConfig]]
+) -> tuple[list[_Pack], list[int]]:
+    """Partition a grid into packs by shape agreement. Returns
+    ``(packs, sequential)`` — ``sequential`` lists the indices of points
+    that cannot pack (:func:`packable`) and must take the per-point path.
+    jax-free: the fleet supervisor plans sub-grids with this."""
+    packs: dict[tuple, _Pack] = {}
+    sequential: list[int] = []
+    for i, (_, cfg) in enumerate(points):
+        if not packable(cfg):
+            sequential.append(i)
+            continue
+        key = pack_shape_key(cfg)
+        pack = packs.get(key)
+        if pack is None:
+            packs[key] = pack = _Pack(key=key, indices=[])
+        pack.indices.append(i)
+    return list(packs.values()), sequential
+
+
+# ---------------------------------------------------------------------------
+# Packed params + dispatch (lazy jax from here down).
+
+
+def stack_params(configs: list[SimConfig], counts: list[int]):
+    """One ``SimParams`` whose every leaf carries a leading runs axis:
+    config ``i``'s params repeated ``counts[i]`` times. The per-run values
+    are exactly what the sequential engine would have broadcast, so the
+    vmapped compute is bit-identical per run."""
+    import jax.numpy as jnp
+
+    from .state import make_params
+
+    per = [make_params(c) for c in configs]
+    reps = np.asarray(counts)
+
+    def stack(leaves):
+        arr = np.stack([np.asarray(v) for v in leaves])
+        return jnp.asarray(np.repeat(arr, reps, axis=0))
+
+    mean = np.repeat(
+        np.asarray([p.mean_interval_ms for p in per], dtype=np.float32), reps
+    )
+    from .state import SimParams
+
+    return SimParams(
+        thresholds=stack([p.thresholds for p in per]),
+        prop_ms=stack([p.prop_ms for p in per]),
+        selfish=stack([p.selfish for p in per]),
+        # float32 per-run scalar: every consumer casts to f32 anyway
+        # (sampling.interval_from_bits), so the value each run sees is
+        # bit-identical to the sequential engine's Python-float broadcast.
+        mean_interval_ms=jnp.asarray(mean),
+        thr64_hi=stack([p.thr64_hi for p in per]),
+        thr64_lo=stack([p.thr64_lo for p in per]),
+    )
+
+
+@dataclasses.dataclass
+class _Piece:
+    """A contiguous slice of one point's runs inside a packed dispatch. Cut
+    at the point's own ``batch_size`` boundaries, so the per-point host
+    accumulation order matches the sequential runner's exactly (float64
+    sums are order-sensitive; integer/moment sums are not)."""
+
+    point: int  # index into the pack's member list
+    start: int  # run offset within the point (the sampling-identity index)
+    count: int
+
+
+def _point_pieces(cfg: SimConfig) -> list[tuple[int, int]]:
+    batch = max(1, min(cfg.batch_size, cfg.runs))
+    return [
+        (start, min(batch, cfg.runs - start))
+        for start in range(0, cfg.runs, batch)
+    ]
+
+
+def _zero_point_sums(n_miners: int) -> dict[str, Any]:
+    return {
+        "blocks_found_sum": np.zeros(n_miners, np.int64),
+        "stale_blocks_sum": np.zeros(n_miners, np.int64),
+        "best_height_sum": np.int64(0),
+        "overflow_sum": np.int64(0),
+        "blocks_share_sum": np.zeros(n_miners, np.float64),
+        "stale_rate_sum": np.zeros(n_miners, np.float64),
+        "runs": np.int64(0),
+    }
+
+
+def _zero_point_tele(n_miners: int) -> dict[str, Any]:
+    from .engine import DEPTH_BUCKETS
+
+    return {
+        "reorg_depth_max": 0,
+        "stale_events": 0,
+        "active_steps": 0,
+        "stale_by_miner": np.zeros(n_miners, np.int64),
+        "reorg_depth_hist": np.zeros(DEPTH_BUCKETS, np.int64),
+    }
+
+
+def _fold_piece(
+    state: dict[str, Any], raw: dict[str, np.ndarray], sl: slice
+) -> None:
+    """Fold one piece's slice of a packed dispatch's raw per-run leaves into
+    a point's accumulators — byte-for-byte the reductions the sequential
+    path applies per batch (engine._host_reduce_sums /
+    _host_reduce_telemetry + the runner's int64/float64 accumulation), just
+    applied to the segment instead of the whole batch. Integer sums are
+    exact in any order; the float64 ratio folds see the same values in the
+    same order as the sequential batch (pieces are batch-boundary cuts), so
+    the per-point results are bit-equal."""
+    sums = state["sums"]
+    found = raw["blocks_found_per_run"][sl]
+    share = raw["blocks_share_per_run"][sl]
+    stale_rate = raw["stale_rate_per_run"][sl]
+    sums["blocks_found_sum"] = sums["blocks_found_sum"] + found.sum(
+        axis=0, dtype=np.int64
+    )
+    sums["stale_blocks_sum"] = sums["stale_blocks_sum"] + raw[
+        "stale_blocks_per_run"
+    ][sl].sum(axis=0, dtype=np.int64)
+    sums["best_height_sum"] = sums["best_height_sum"] + raw[
+        "best_height_per_run"
+    ][sl].sum(dtype=np.int64)
+    sums["overflow_sum"] = sums["overflow_sum"] + raw["overflow_per_run"][
+        sl
+    ].sum(dtype=np.int64)
+    # The float64 host fold of the sequential path (_host_reduce_sums):
+    # same dtype ladder, same axis, same element order.
+    sums["blocks_share_sum"] = sums["blocks_share_sum"] + share.astype(
+        np.float64
+    ).sum(axis=0)
+    sums["stale_rate_sum"] = sums["stale_rate_sum"] + stale_rate.astype(
+        np.float64
+    ).sum(axis=0)
+    sums["runs"] = sums["runs"] + np.int64(found.shape[0])
+
+    # Exact int64 moment keys (tpusim.convergence) per piece, folded by the
+    # accumulator exactly as the runner folds per-batch keys.
+    per = {"blocks_found": found, "blocks_share": share,
+           "stale_rate": stale_rate}
+    assert len(per) == len(MOMENT_STATS)
+    state["moments"].add(moment_keys(per))
+
+    tele = state["tele"]
+    tele["reorg_depth_max"] = max(
+        tele["reorg_depth_max"],
+        int(raw["tele_reorg_depth_per_run"][sl].max(initial=0)),
+    )
+    tele["stale_events"] += int(
+        raw["tele_stale_events_per_run"][sl].astype(np.int64).sum()
+    )
+    tele["active_steps"] += int(
+        raw["tele_active_steps_per_run"][sl].astype(np.int64).sum()
+    )
+    tele["stale_by_miner"] = tele["stale_by_miner"] + raw[
+        "tele_stale_by_miner_per_run"
+    ][sl].astype(np.int64).sum(axis=0)
+    tele["reorg_depth_hist"] = tele["reorg_depth_hist"] + raw[
+        "tele_reorg_depth_hist_per_run"
+    ][sl].astype(np.int64).sum(axis=0)
+
+
+def _make_packed_engine(
+    configs: list[SimConfig],
+    *,
+    engine: str = "auto",
+    engine_cache: dict | None = None,
+    pack_width: int | None = None,
+    pallas_kwargs: dict | None = None,
+):
+    """Build (or fetch from ``engine_cache``) the packed engine for one
+    pack: a representative config pinned to the pack's resolved chunk budget
+    and worst-case count dtype, duration set to the pack max so the chunk
+    limit covers every member."""
+    import jax
+
+    from .engine import Engine
+
+    dtype = packed_count_dtype(configs)
+    cs = _resolved_chunk_steps(configs[0])
+    max_dur = max(c.duration_ms for c in configs)
+    # Engine.max_chunks derives from default_n_steps(duration, interval),
+    # and a pack may MIX block intervals (the 4096 clamp makes short-
+    # interval chunk budgets coincide in pack_shape_key) — so the
+    # representative takes the worst-event-bound member's network: with the
+    # pack-max duration on top its bound dominates every member's own, or a
+    # shorter-interval member would exhaust the chunk loop ("batch did not
+    # finish"). The interval itself is a runtime tensor like the roster.
+    worst = max(configs, key=lambda c: c._event_bound(c.duration_ms))
+    # Resolve with "auto" first: pinning "int16" here would make
+    # SimConfig.__post_init__ raise inside dataclasses.replace whenever the
+    # synthetic representative (worst roster x the pack-max duration)
+    # exceeds the members' own bounds, before the widening check could run.
+    rep = dataclasses.replace(
+        configs[0], network=worst.network, duration_ms=max_dur,
+        chunk_steps=cs, state_dtype="auto",
+        runs=max(c.runs for c in configs),
+    )
+    if dtype == "int16" and rep._count_bound_fits_int16:
+        rep = dataclasses.replace(rep, state_dtype="int16")
+    else:
+        # dtype is not part of the sampling identity, so widening the
+        # representative is always safe — just less packed.
+        rep = dataclasses.replace(rep, state_dtype="int32")
+
+    def build():
+        # tpusim-lint: disable=JX001 -- `engine` is the host-side string knob
+        # ("auto"/"scan"/"pallas"), never a tracer; build() runs pre-trace.
+        if engine == "pallas" or (
+            engine == "auto"
+            and jax.devices()[0].platform == "tpu"
+            and jax.process_count() == 1
+        ):
+            try:
+                from .pallas_engine import PallasEngine
+
+                return PallasEngine(rep, packed=True, **(pallas_kwargs or {}))
+            except ValueError:
+                if engine == "pallas":
+                    raise
+                logger.info(
+                    "pack not eligible for the pallas engine; using scan"
+                )
+        return Engine(rep, packed=True)
+
+    if engine_cache is None:
+        return build()
+    key = ("packed", engine, pack_shape_key(rep), rep.resolved_count_dtype,
+           rep.duration_ms, pack_width,
+           tuple(sorted((pallas_kwargs or {}).items())))
+    eng = engine_cache.get(key)
+    if eng is None:
+        engine_cache[key] = eng = build()
+    return eng
+
+
+def _pad_width(width: int, eng) -> int:
+    """Round a dispatch width up to the engine's alignment unit (the pallas
+    run tile; 1 for the scan engine)."""
+    unit = getattr(eng, "tile_runs", 1)
+    return (width + unit - 1) // unit * unit
+
+
+#: Lazily-jitted whole-batch key builder (see _batch_run_keys).
+_KEYS_FN = None
+
+#: Host cache of ``jax.random.key(seed)``'s raw uint32 key data, per seed.
+#: Bounded by the distinct seeds a process ever packs (grids share one seed
+#: per config, typically one per grid).
+_BASE_KEY_DATA: dict[int, np.ndarray] = {}
+
+
+def _base_key_data(seed: int) -> np.ndarray:
+    """Raw key data of ``jax.random.key(seed)`` — the SAME host construction
+    the sequential ``runner.make_run_keys`` starts from, so every seed the
+    sequential path accepts produces identical draws packed. (A direct
+    ``np.uint32(seed)`` cast would diverge: jax wraps out-of-range Python
+    ints where numpy 2.x raises.)"""
+    kd = _BASE_KEY_DATA.get(seed)
+    if kd is None:
+        import jax
+
+        kd = np.asarray(jax.random.key_data(jax.random.key(seed)))
+        _BASE_KEY_DATA[seed] = kd
+    return kd
+
+
+def _batch_run_keys(key_data: np.ndarray, idx: np.ndarray):
+    """All pieces' run keys in ONE jitted call — bit-identical to per-piece
+    ``runner.make_run_keys`` (``fold_in(key(seed), i)`` per run; pinned by
+    the packed-vs-sequential row equality), but without its per-call eager
+    dispatch cost: at reference grid shapes the per-piece key builds were
+    ~40% of the packed dispatch wall time. ``key_data`` is the per-run
+    ``(n, 2)`` uint32 base-key array (:func:`_base_key_data` per config)."""
+    global _KEYS_FN
+    import jax
+    import jax.numpy as jnp
+
+    if _KEYS_FN is None:
+        def build(kd, idx):
+            keys = jax.random.wrap_key_data(kd)
+            return jax.vmap(jax.random.fold_in)(keys, idx)
+
+        _KEYS_FN = jax.jit(build)
+    return _KEYS_FN(jnp.asarray(key_data), jnp.asarray(idx))
+
+
+def _dispatch(
+    eng,
+    members: list[SimConfig],
+    pieces: list[_Piece],
+    width: int,
+    *,
+    host_loop: bool = False,
+    pipelined: bool = False,
+    params_cache: dict | None = None,
+):
+    """Run one packed dispatch of ``pieces`` (padded to ``width`` runs) and
+    return the raw per-run leaves. Pad lanes carry duration 0 — they freeze
+    at step one and cost (almost) nothing — and are never sliced by any
+    piece. ``params_cache`` (keyed by the dispatch's exact (config, count)
+    layout — SimConfig is frozen, hence hashable) skips re-stacking and
+    re-uploading the per-run params when the same layout dispatches again:
+    a repeated grid or an adaptive loop at stable allocation pays the
+    host->device params transfer once."""
+    total = sum(p.count for p in pieces)
+    npad = width - total
+    assert npad >= 0, (width, total)
+    cfgs = [members[p.point] for p in pieces]
+    counts = [p.count for p in pieces]
+    durations = np.repeat(
+        np.asarray([c.duration_ms for c in cfgs], np.int64), counts
+    )
+    key_data = np.repeat(
+        np.stack([_base_key_data(c.seed) for c in cfgs]), counts, axis=0
+    )
+    idx = np.concatenate(
+        [np.arange(p.start, p.start + p.count) for p in pieces]
+    )
+    if npad:
+        cfgs = cfgs + [cfgs[0]]
+        counts = counts + [npad]
+        durations = np.concatenate([durations, np.zeros(npad, np.int64)])
+        key_data = np.concatenate(
+            [key_data, np.repeat(_base_key_data(0)[None], npad, axis=0)]
+        )
+        idx = np.concatenate([idx, np.arange(npad)])
+    layout = ("packed_params", tuple(cfgs), tuple(counts))
+    params = params_cache.get(layout) if params_cache is not None else None
+    if params is None:
+        params = stack_params(cfgs, counts)
+        if params_cache is not None:
+            params_cache[layout] = params
+    eng.params = params
+    eng.run_durations = durations
+    keys = _batch_run_keys(key_data, idx)
+    raw = eng.run_batch(keys, host_loop=host_loop, pipelined=pipelined)
+    return raw
+
+
+def run_grid(
+    points: list[tuple[str, SimConfig]],
+    *,
+    engine: str = "auto",
+    engine_cache: dict | None = None,
+    pack_width: int | None = None,
+    host_loop: bool = False,
+    pipelined: bool = False,
+    telemetry=None,
+    chaos=None,
+    pallas_kwargs: dict | None = None,
+    progress=None,
+) -> list[dict[str, Any]]:
+    """Run every (packable) point of one shape-agreement pack as packed
+    device dispatches; returns one result dict per point, in input order:
+    ``{"name", "results": SimResults, "sums", "moments", "tele",
+    "elapsed_s"}``. ``points`` must all share one :func:`pack_shape_key`
+    (``run_sweep(packed=True)`` plans the partition; this function trusts
+    it). ``pack_width`` fixes the dispatch width (defaults to the largest
+    member ``batch_size``, clamped to the grid total) — every dispatch of a
+    multi-dispatch grid is padded to it, so the whole grid compiles ONE
+    program and a second same-width grid compiles nothing
+    (compile_count_guard(exact=0), tests/test_packed_sweep.py).
+    ``progress(done_runs, total_runs)`` fires after every dispatch with
+    grid-cumulative counts — the runner's per-batch callback contract, so a
+    fleet worker's heartbeat can carry packed progress too."""
+    members = [cfg for _, cfg in points]
+    names = [name for name, _ in points]
+    if not members:
+        return []
+    keyset = {pack_shape_key(c) for c in members}
+    if len(keyset) != 1:
+        raise ValueError(
+            f"run_grid needs one shape-agreement pack, got {len(keyset)} "
+            f"distinct shapes; plan with plan_packs/run_sweep(packed=True)"
+        )
+
+    t0 = time.monotonic()
+    eng = _make_packed_engine(
+        members, engine=engine, engine_cache=engine_cache,
+        pack_width=pack_width, pallas_kwargs=pallas_kwargs,
+    )
+    eng.chaos = chaos
+    m = members[0].network.n_miners
+
+    # Pieces in point order, cut at each point's own batch boundaries.
+    pieces: list[_Piece] = []
+    for i, cfg in enumerate(members):
+        pieces.extend(_Piece(i, s, c) for s, c in _point_pieces(cfg))
+    total = sum(p.count for p in pieces)
+    width = pack_width or min(total, max(c.batch_size for c in members))
+    width = max(width, max(p.count for p in pieces))
+    width = _pad_width(min(width, total) if pack_width is None else width, eng)
+
+    # Greedy fill: consecutive pieces until the width is reached. Every
+    # dispatch is padded to the shared width so the compiled program is one.
+    dispatches: list[list[_Piece]] = [[]]
+    fill = 0
+    for p in pieces:
+        if fill + p.count > width and dispatches[-1]:
+            dispatches.append([])
+            fill = 0
+        dispatches[-1].append(p)
+        fill += p.count
+
+    state = [
+        {"sums": _zero_point_sums(m), "moments": MomentAccumulator(),
+         "tele": _zero_point_tele(m)}
+        for _ in members
+    ]
+    runs_done = 0
+    for di, batch in enumerate(dispatches):
+        t_d = time.monotonic()
+        raw = _dispatch(
+            eng, members, batch, width,
+            host_loop=host_loop, pipelined=pipelined,
+            params_cache=engine_cache,
+        )
+        off = 0
+        for p in batch:
+            _fold_piece(state[p.point], raw, slice(off, off + p.count))
+            off += p.count
+        runs_done += sum(p.count for p in batch)
+        if progress is not None:
+            progress(runs_done, total)
+        if telemetry is not None:
+            telemetry.emit(
+                "packed_dispatch", dur_s=round(time.monotonic() - t_d, 6),
+                dispatch=di, dispatches=len(dispatches), width=width,
+                runs=sum(p.count for p in batch), pieces=len(batch),
+                points=len({p.point for p in batch}),
+                engine=type(eng).__name__,
+                chunks=int(raw.get("tele_chunks_max", 0)),
+            )
+
+    # Per-point wall-clock: the pack ran as one program, so the only honest
+    # per-point attribution is the pack's elapsed AMORTIZED over its members
+    # — summing member rows then recovers the true wall-clock instead of
+    # over-counting it N-fold (sweep_point span durations stay additive).
+    elapsed = (time.monotonic() - t0) / len(members)
+    out = []
+    for i, (name, cfg) in enumerate(zip(names, members)):
+        st = state[i]
+        res = SimResults.from_sums(
+            st["sums"], cfg, mode=cfg.resolved_mode,
+            elapsed_s=round(elapsed, 6),
+        )
+        if telemetry is not None:
+            # Segment-aware stats span: one per point, `point` names the
+            # segment — `tpusim watch`/`report` render these as the
+            # per-point convergence table instead of one blended run.
+            telemetry.emit(
+                "stats", point=name, runs=st["moments"].n,
+                runs_done=st["moments"].n, runs_total=cfg.runs,
+                duration_ms=cfg.duration_ms,
+                block_interval_s=cfg.network.block_interval_s,
+                packed=True,
+                stats=st["moments"].snapshot(),
+            )
+        out.append({
+            "name": name, "results": res, "sums": st["sums"],
+            "moments": st["moments"], "tele": st["tele"],
+            "elapsed_s": elapsed,
+        })
+    return out
+
+
+def _allocate_lanes(
+    active: list[int],
+    need: dict[int, float],
+    remaining: dict[int, int],
+    lanes: int,
+    min_runs: int,
+) -> dict[int, int]:
+    """Split ``lanes`` runs across ``active`` points proportionally to
+    ``need``, each clamped to its ``remaining`` budget and floored at
+    ``min_runs``. Integer-rounding overshoot is trimmed from the
+    smallest-need points first (the widest-CI point keeps its share) but
+    NEVER below the ``min_runs`` floor: callers guarantee
+    ``len(active) * min_runs <= lanes``, so once every point sits at the
+    floor the total already fits and the trim loop has terminated."""
+    total_need = sum(need[i] for i in active)
+    alloc = {
+        i: min(
+            remaining[i],
+            max(min_runs, int(round(lanes * need[i] / total_need))),
+        )
+        for i in active
+    }
+    while sum(alloc.values()) > lanes:
+        i = min(
+            (i for i in active if alloc[i] > min_runs),
+            key=lambda i: need[i], default=None,
+        )
+        if i is None:
+            break
+        alloc[i] -= 1
+    return alloc
+
+
+def run_grid_adaptive(
+    points: list[tuple[str, SimConfig]],
+    *,
+    ci_target_stat: str,
+    ci_target_rel: float = 0.01,
+    lanes: int | None = None,
+    max_rounds: int = 32,
+    min_runs: int = 2,
+    engine: str = "auto",
+    engine_cache: dict | None = None,
+    telemetry=None,
+    quiet: bool = True,
+) -> list[dict[str, Any]]:
+    """Run-until-confident over a packed grid: the ``ci_target_stat``
+    convergence driver (the runner's adaptive-precision machinery) deciding
+    *runs per point* inside the packed batch. Every round dispatches one
+    packed batch of ``lanes`` runs; unconverged points split the lanes in
+    proportion to their estimated remaining need (``n * (rel/target)^2 - n``
+    — the 1/sqrt(n) extrapolation of tpusim.convergence), so wide-CI points
+    get more lanes next round and converged points stop consuming any. The
+    dispatch width is CONSTANT (padded), so the whole loop runs on one
+    compiled program. Each point's runs extend its sequential sampling
+    identity (run index continues where the last round stopped), and
+    ``config.runs`` stays the per-point budget ceiling.
+
+    Returns per-point result dicts like :func:`run_grid`, plus
+    ``converged``/``rounds`` fields; statistics cover exactly the runs each
+    point executed."""
+    known = tuple(s for s, _, _ in MOMENT_STATS)
+    if ci_target_stat not in known:
+        raise ValueError(
+            f"unknown ci_target_stat {ci_target_stat!r}; use one of {known}"
+        )
+    if not (ci_target_rel and ci_target_rel > 0):
+        raise ValueError("ci_target_stat needs a positive ci_target_rel")
+    members = [cfg for _, cfg in points]
+    names = [name for name, _ in points]
+    keyset = {pack_shape_key(c) for c in members}
+    if len(keyset) != 1:
+        raise ValueError(
+            "run_grid_adaptive needs one shape-agreement pack; plan with "
+            "plan_packs"
+        )
+    m = members[0].network.n_miners
+    n_points = len(members)
+    if lanes is None:
+        lanes = max(c.batch_size for c in members)
+    lanes = max(lanes, n_points * min_runs)
+
+    t0 = time.monotonic()
+    eng = _make_packed_engine(
+        members, engine=engine, engine_cache=engine_cache, pack_width=lanes,
+    )
+    width = _pad_width(lanes, eng)
+    # Per-CALL params cache: adaptive rounds produce a fresh (config, count)
+    # layout almost every round, so caching them in the session-lived
+    # engine_cache (run_grid's static-grid win) would grow it without bound
+    # — only a STABLE allocation repeating within this loop can re-hit.
+    params_cache: dict = {}
+    state = [
+        {"sums": _zero_point_sums(m), "moments": MomentAccumulator(),
+         "tele": _zero_point_tele(m), "done": 0, "converged": False,
+         "rel": None}
+        for _ in members
+    ]
+
+    def remaining(i: int) -> int:
+        return max(0, members[i].runs - state[i]["done"])
+
+    rounds = 0
+    for rounds in range(1, max_rounds + 1):
+        # Lane allocation: equal split on round 1 (no CI yet), then
+        # proportional to each point's estimated remaining need.
+        active = [
+            i for i in range(n_points)
+            if not state[i]["converged"] and remaining(i) > 0
+        ]
+        if not active:
+            break
+        need = {}
+        for i in active:
+            rel = state[i]["rel"]
+            if rel is None:
+                need[i] = 1.0
+            else:
+                n_i = max(state[i]["moments"].n, 1)
+                need[i] = max(1.0, n_i * ((rel / ci_target_rel) ** 2 - 1.0))
+        alloc = _allocate_lanes(
+            active, need, {i: remaining(i) for i in active}, lanes, min_runs,
+        )
+        pieces = [
+            _Piece(i, state[i]["done"], alloc[i])
+            for i in active if alloc[i] > 0
+        ]
+        if not pieces:
+            break
+        raw = _dispatch(eng, members, pieces, width,
+                        params_cache=params_cache)
+        off = 0
+        for p in pieces:
+            _fold_piece(state[p.point], raw, slice(off, off + p.count))
+            state[p.point]["done"] += p.count
+            off += p.count
+        for i in active:
+            snap = state[i]["moments"].snapshot(target_rel_hw=ci_target_rel)
+            entry = snap.get(ci_target_stat) or {}
+            rel = entry.get("rel_hw_max")
+            state[i]["rel"] = float(rel) if isinstance(rel, (int, float)) else None
+            if state[i]["rel"] is not None and state[i]["rel"] <= ci_target_rel:
+                state[i]["converged"] = True
+            if telemetry is not None:
+                telemetry.emit(
+                    "stats", point=names[i], runs=state[i]["moments"].n,
+                    runs_done=state[i]["done"], runs_total=members[i].runs,
+                    duration_ms=members[i].duration_ms,
+                    block_interval_s=members[i].network.block_interval_s,
+                    target_rel_hw=ci_target_rel, packed=True, round=rounds,
+                    lanes=alloc.get(i, 0),
+                    converged=state[i]["converged"], stats=snap,
+                )
+        if not quiet:
+            rels = ", ".join(
+                f"{names[i]}={state[i]['rel'] if state[i]['rel'] is not None else '?'}"
+                for i in active
+            )
+            print(f"[packed] round {rounds}: {rels}")
+        if all(s["converged"] or remaining(i) == 0
+               for i, s in enumerate(state)):
+            break
+
+    # Amortized like run_grid's: member rows sum to the true wall-clock.
+    elapsed = (time.monotonic() - t0) / len(members)
+    out = []
+    for i, (name, cfg) in enumerate(zip(names, members)):
+        st = state[i]
+        res = SimResults.from_sums(
+            st["sums"], cfg, mode=cfg.resolved_mode,
+            elapsed_s=round(elapsed, 6),
+        )
+        out.append({
+            "name": name, "results": res, "sums": st["sums"],
+            "moments": st["moments"], "tele": st["tele"],
+            "elapsed_s": elapsed, "converged": st["converged"],
+            "rounds": rounds, "rel": st["rel"],
+        })
+    return out

@@ -682,7 +682,18 @@ class PallasEngine(Engine):
         step_block: int = 64,
         interpret: bool = False,
         vmem_guard: bool = True,
+        packed: bool = False,
     ):
+        if packed and not config.rng_batch:
+            # Under rng_batch the kernel consumes PRE-MAPPED (winner,
+            # interval) rows — thresholds and the mean interval live in the
+            # XLA pre-pass, which handles per-run params like any other
+            # vectorized op. The legacy raw-words path bakes them into the
+            # kernel body, so packing requires the batched sampler.
+            raise ValueError(
+                "packed pallas engines need rng_batch=True (the kernel's "
+                "sampler params become per-run tensors in the XLA pre-pass)"
+            )
         if mesh is not None and jax.process_count() > 1:
             raise ValueError(
                 "PallasEngine shards batches over single-controller meshes "
@@ -761,7 +772,7 @@ class PallasEngine(Engine):
                 f"the 16 MB scoped limit ({m} miners, {'exact' if exact else 'fast'} "
                 f"mode, tile_runs={tile_runs}); use the scan engine"
             )
-        super().__init__(config, mesh)
+        super().__init__(config, mesh, packed=packed)
         #: The guard's estimate, kept for the telemetry memory attrs
         #: (memory_attrs): the per-batch ledger reports estimate vs. budget.
         self.vmem_est = int(vmem_est)
@@ -830,7 +841,14 @@ class PallasEngine(Engine):
         # constants of the jitted _pallas_chunk and the mean interval is a
         # Python float inside the kernel body — so pallas reuse additionally
         # requires the full roster, the interval, and the tiling knobs.
+        # PACKED engines bake none of that: propagation/selfish stream in as
+        # per-run (M, R) kernel refs and the sampler params live in the XLA
+        # pre-pass, so only the tiling knobs extend the scan key.
         c = self.config
+        if self.packed:
+            return super().reuse_key() + (
+                self.tile_runs, self.step_block, self.interpret,
+            )
         roster = tuple(
             (mc.hashrate_pct, mc.propagation_ms, mc.selfish)
             for mc in c.network.miners
@@ -869,13 +887,21 @@ class PallasEngine(Engine):
             import dataclasses
 
             self._scan_fallback = Engine(
-                dataclasses.replace(self.config, chunk_steps=self.chunk_steps)
+                dataclasses.replace(self.config, chunk_steps=self.chunk_steps),
+                packed=self.packed,
             )
         # The twin serves the same logical batch: it inherits the fault-
         # injection seam and the pipelined-fetch watchdog (refreshed on
         # every call — the runner may attach/detach chaos between batches).
         self._scan_fallback.chaos = self.chaos
         self._scan_fallback.flag_fetch_timeout_s = self.flag_fetch_timeout_s
+        if self.packed:
+            # Packed runtime inputs travel with the batch, not the config:
+            # the twin must see the SAME per-run params/durations this
+            # engine was dispatched with.
+            self._scan_fallback.params = self.params
+            self._scan_fallback.run_durations = self.run_durations
+            self._scan_fallback.max_chunks = self.max_chunks
         return self._scan_fallback
 
     def run_batch(self, keys, *, host_loop: bool = False, pipelined: bool = False):
@@ -888,6 +914,15 @@ class PallasEngine(Engine):
         rem = n % unit
         if rem == 0:
             return super().run_batch(keys, host_loop=host_loop, pipelined=pipelined)
+        if self.packed:
+            # The head/tail split slices KEYS but the per-run params and
+            # durations ride on the engine — a silent split would misalign
+            # them. The packed dispatcher pads every dispatch to the tile
+            # unit (tpusim.packed._pad_width), so this is a caller bug.
+            raise ValueError(
+                f"packed pallas dispatch of {n} runs is not a multiple of "
+                f"{unit} (tile_runs x devices); pad the pack width"
+            )
         logger.info(
             "batch of %d is not a multiple of %d (tile_runs x devices); "
             "%d run(s) take the scan engine",
@@ -1027,11 +1062,31 @@ class PallasEngine(Engine):
             nd = len(shape)
             return pl.BlockSpec(shape, lambda i, j, nd=nd: (0,) * nd, memory_space=pltpu.VMEM)
 
-        # self.params.mean_interval_ms is the concrete Python float; the
-        # traced `params` copy would be a captured constant in the kernel.
+        if self.packed:
+            # Grid packing: propagation delays and selfish flags become
+            # per-run (M, R) kernel refs, tiled like the state (the kernel
+            # body broadcasts (M, tile) exactly as it broadcast (M, 1), so
+            # the per-lane arithmetic is bit-identical). The sampler params
+            # (thresholds, mean interval) already rode the per-run XLA
+            # pre-pass above under rng_batch — which packed mode requires —
+            # so the kernel itself needs no sampler inputs at all; the
+            # lo/hi refs stay as unused (M, 1) placeholders and the baked
+            # mean is dead code behind the rng_batch branch.
+            prop_in = jnp.moveaxis(params.prop_ms, 0, -1)
+            selfish_in = jnp.moveaxis(params.selfish.astype(I32), 0, -1)
+            prop_spec = selfish_spec = tile_spec((m, n))
+            mean_for_kernel = 0.0
+        else:
+            prop_in, selfish_in = self._prop, self._selfish
+            prop_spec = selfish_spec = const_spec((m, 1))
+            # self.params.mean_interval_ms is the concrete Python float; the
+            # traced `params` copy would be a captured constant in the
+            # kernel.
+            mean_for_kernel = float(self.params.mean_interval_ms)
+
         kernel = _make_kernel(
             exact=self.exact, any_selfish=self.any_selfish, sb=sb,
-            mean_interval_ms=float(self.params.mean_interval_ms),
+            mean_interval_ms=mean_for_kernel,
             n_state=len(shapes), superstep=self.superstep,
             flight_capacity=fcap, rng_batch=self.config.rng_batch,
             count_dtype=cdt, gather=self.config.consensus_gather,
@@ -1045,15 +1100,15 @@ class PallasEngine(Engine):
                 tile_spec((1, n)),  # cap
                 const_spec((m, 1)),  # lo
                 const_spec((m, 1)),  # hi
-                const_spec((m, 1)),  # prop
-                const_spec((m, 1)),  # selfish
+                prop_spec,  # prop (per-run (M, R) when packed)
+                selfish_spec,  # selfish (per-run (M, R) when packed)
                 *[tile_spec(s) for s in shapes],
             ],
             out_specs=[tile_spec(s) for s in shapes],
             out_shape=[jax.ShapeDtypeStruct(s, d) for s, d in zip(shapes, dtypes)],
             input_output_aliases={6 + i: i for i in range(len(shapes))},
             interpret=self.interpret,
-        )(bits, cap[None, :], self._lo, self._hi, self._prop, self._selfish, *st)
+        )(bits, cap[None, :], self._lo, self._hi, prop_in, selfish_in, *st)
 
         n_tail = len(_TELE_LEAVES) + (len(_FLIGHT_LEAVES) if fcap else 0)
         out, tail = out[: len(out) - n_tail], out[len(out) - n_tail:]

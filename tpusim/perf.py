@@ -52,6 +52,7 @@ __all__ = [
     "append_rows",
     "load_rows",
     "run_protocol",
+    "run_sweep_protocol",
     "compare_rows",
     "render_compare",
     "render_report",
@@ -76,6 +77,27 @@ PROTOCOL: dict[str, dict[str, int]] = {
     "full": {"runs": 512, "n_chunks": 12, "repeats": 5, "chunk_steps": 256},
     "quick": {"runs": 128, "n_chunks": 4, "repeats": 3, "chunk_steps": 256},
 }
+
+#: The packed-sweep protocol (run_sweep_protocol): grid points/sec on a
+#: scaled reference selfish-threshold grid, sequential vs packed dispatch
+#: (tpusim.packed). Deliberately dispatch-bound — few runs per point, so the
+#: measurement isolates the per-point round-trip cost grid packing exists to
+#: remove; repeats are INTERLEAVED sequential/packed (the worktree A/B
+#: discipline, in-process).
+SWEEP_PROTOCOL: dict[str, dict[str, Any]] = {
+    "full": {"intervals": (150.0, 300.0, 600.0), "pcts": (25, 30, 35, 40, 45),
+             "runs": 8, "duration_ms": 21_600_000, "repeats": 5},
+    "quick": {"intervals": (600.0,), "pcts": (25, 30, 35, 40, 45),
+              "runs": 4, "duration_ms": 21_600_000, "repeats": 3},
+}
+
+#: The sweep-protocol scenario name accepted by ``perf run --scenarios``
+#: next to the chained-chunk ones; it emits BOTH the ``sweep_packed`` row
+#: and its ``sweep_sequential`` before-twin.
+SWEEP_SCENARIO = "packed_sweep"
+
+#: ``perf run``'s default scenario set (``--scenarios`` unset).
+DEFAULT_RUN_SCENARIOS = "fast,exact,fast_yearlong,packed_sweep"
 
 def _git_rev() -> str | None:
     try:
@@ -288,6 +310,9 @@ def run_protocol(
             "state_dtype": cfg.resolved_count_dtype,
             "consensus_gather": cfg.consensus_gather,
             "count_rebase": cfg.count_rebase,
+            # The chained scenarios time ONE config's program — never the
+            # packed-grid dispatch mode (that domain is sweep_packed's).
+            "packed": False,
         }
         rows.append(perf_row(
             f"chained_{name}", "s_per_chunk", timing["s_per_chunk"],
@@ -300,6 +325,76 @@ def run_protocol(
                 "spread_pct": timing["spread_pct"],
                 "protocol": "quick" if quick else "full",
             },
+        ))
+    return rows
+
+
+def run_sweep_protocol(
+    *, quick: bool = False, repeats: int | None = None
+) -> list[dict]:
+    """Measure grid points/sec on the scaled reference selfish-threshold
+    grid, sequential vs packed dispatch, and return BOTH ledger rows
+    (``sweep_sequential`` / ``sweep_packed``, better=higher, value = best
+    repeat). Both paths run through ``run_sweep`` on one shared engine cache
+    after a warmup pass of each, so compiles are excluded and the repeats
+    time pure dispatch+reduction; the packed row records its measured
+    ``speedup_x`` over the sequential best."""
+    from .config import NetworkConfig, SimConfig
+    from .sweep import _selfish_network, run_sweep
+
+    p = dict(SWEEP_PROTOCOL["quick" if quick else "full"])
+    if repeats is not None:
+        p["repeats"] = repeats
+    duration_ms = int(p["duration_ms"])
+    batch = len(p["pcts"]) * int(p["runs"])
+    points = []
+    for interval_s in p["intervals"]:
+        for pct in p["pcts"]:
+            net = _selfish_network(pct)
+            net = NetworkConfig(miners=net.miners, block_interval_s=interval_s)
+            points.append((
+                f"interval-{int(interval_s)}s-selfish-{pct}pct",
+                SimConfig(network=net, runs=int(p["runs"]),
+                          duration_ms=duration_ms, batch_size=batch, seed=7),
+            ))
+    cfg0 = points[0][1]
+    cache: dict = {}
+
+    def sweep(packed: bool) -> None:
+        run_sweep(points, quiet=True, engine_cache=cache, packed=packed)
+
+    sweep(False)
+    sweep(True)  # warmup both paths: every program compiled, caches primed
+    n = len(points)
+    samples: dict[bool, list[float]] = {False: [], True: []}
+    for _ in range(int(p["repeats"])):
+        for packed in (False, True):  # interleaved A/B
+            t0 = time.perf_counter()
+            sweep(packed)
+            samples[packed].append(n / (time.perf_counter() - t0))
+    shape = {
+        "points": n,
+        "runs_per_point": int(p["runs"]),
+        "duration_ms": duration_ms,
+        "batch_size": batch,
+        "mode": cfg0.resolved_mode,
+        "rng_batch": cfg0.rng_batch,
+        "state_dtype": cfg0.resolved_count_dtype,
+        "consensus_gather": cfg0.consensus_gather,
+        "count_rebase": cfg0.count_rebase,
+    }
+    protocol = "quick" if quick else "full"
+    rows = []
+    for packed, scenario in ((False, "sweep_sequential"), (True, "sweep_packed")):
+        extra: dict[str, Any] = {"protocol": protocol}
+        if packed:
+            extra["speedup_x"] = round(
+                max(samples[True]) / max(samples[False]), 3
+            )
+        rows.append(perf_row(
+            scenario, "points_per_s", max(samples[packed]),
+            unit="points/s", better="higher", samples=samples[packed],
+            shape={**shape, "packed": packed}, extra=extra,
         ))
     return rows
 
@@ -469,8 +564,11 @@ def main(argv: list[str] | None = None) -> int:
                             "min-of-3) instead of the full evidence shape "
                             "(512 runs, 12 chunks, min-of-5)")
     p_run.add_argument("--engine", choices=("auto", "scan", "pallas"), default="auto")
-    p_run.add_argument("--scenarios", default="fast,exact,fast_yearlong",
-                       help="comma-separated subset of fast,exact,fast_yearlong")
+    p_run.add_argument("--scenarios", default=None,
+                       help="comma-separated subset of "
+                            f"{DEFAULT_RUN_SCENARIOS} (the default; "
+                            "packed_sweep emits the sweep_sequential + "
+                            "sweep_packed points/sec pair)")
     p_run.add_argument("--runs", type=int)
     p_run.add_argument("--n-chunks", type=int)
     p_run.add_argument("--repeats", type=int)
@@ -493,12 +591,35 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
 
     if args.cmd == "run":
-        scenarios = tuple(s for s in args.scenarios.split(",") if s)
-        rows = run_protocol(
-            quick=args.quick, engine=args.engine, scenarios=scenarios,
-            runs=args.runs, n_chunks=args.n_chunks, repeats=args.repeats,
-            chunk_steps=args.chunk_steps,
+        explicit = args.scenarios is not None
+        scenarios = tuple(
+            s for s in (args.scenarios or DEFAULT_RUN_SCENARIOS).split(",")
+            if s
         )
+        if SWEEP_SCENARIO in scenarios and args.engine != "auto":
+            # run_sweep_protocol measures the auto-selected engine pair end
+            # to end (run_sweep has no engine knob); appending its rows
+            # under a pinned --engine would mislabel the ledger.
+            if explicit:
+                ap.error(
+                    f"--engine {args.engine} cannot pin the "
+                    f"{SWEEP_SCENARIO} scenario (the sweep protocol "
+                    f"measures the auto-selected engine); drop it from "
+                    f"--scenarios or use --engine auto"
+                )
+            print(f"[perf] skipping {SWEEP_SCENARIO}: --engine "
+                  f"{args.engine} pins the chained scenarios only")
+            scenarios = tuple(s for s in scenarios if s != SWEEP_SCENARIO)
+        chained = tuple(s for s in scenarios if s != SWEEP_SCENARIO)
+        rows = []
+        if chained:
+            rows += run_protocol(
+                quick=args.quick, engine=args.engine, scenarios=chained,
+                runs=args.runs, n_chunks=args.n_chunks, repeats=args.repeats,
+                chunk_steps=args.chunk_steps,
+            )
+        if SWEEP_SCENARIO in scenarios:
+            rows += run_sweep_protocol(quick=args.quick, repeats=args.repeats)
         if args.out is not None:
             out = args.out
         else:

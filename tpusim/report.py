@@ -396,12 +396,32 @@ def render_report(spans: list[dict], fmt: str = "text") -> str:
         # sweep, which shares one run_id across points) renders each
         # segment's own trajectory; a run-count drop inside one group marks
         # a new accumulator (next sweep point).
-        from .convergence import format_num, snapshot_rows
+        from .convergence import format_num, point_snapshot_rows, snapshot_rows
 
         sgroups: dict[str, list[dict]] = {}
         for sp in sstats:
             sgroups.setdefault(sp.get("run_id", "?"), []).append(sp)
         for rid, group in sgroups.items():
+            prow = point_snapshot_rows(group)
+            if prow is not None:
+                # Packed sweep: the spans are per-POINT segments
+                # (tpusim.packed) — render per-point CI narrowing instead of
+                # one blended run.
+                heading(
+                    "Convergence by grid point (packed sweep)"
+                    if len(sgroups) == 1
+                    else f"Convergence by grid point — run {rid}"
+                )
+                table(["point", "runs", "rel hw95 (worst stat)", "status"], prow)
+                # A MIXED sweep also carries plain spans from unpackable
+                # fallback points (they ran through the runner) — their
+                # blended panel renders below from its own span subset.
+                group = [
+                    sp for sp in group
+                    if not isinstance((sp.get("attrs") or {}).get("point"), str)
+                ]
+                if not group:
+                    continue
             a = group[-1].get("attrs") or {}
             heading(
                 "Convergence (stats spans)" if len(sgroups) == 1

@@ -106,4 +106,7 @@ def winners_from_bits(bits: jax.Array, thresholds: jax.Array) -> jax.Array:
     w = jnp.sum(
         (thresholds <= bits[..., None]).astype(jnp.int32), axis=-1, dtype=jnp.int32
     )
-    return jnp.minimum(w, jnp.int32(thresholds.shape[0] - 1))
+    # shape[-1], not shape[0]: packed grids pass per-run (R, M) thresholds
+    # (tpusim.packed) — the miner axis is always last, and for the 1-D case
+    # the two are the same axis.
+    return jnp.minimum(w, jnp.int32(thresholds.shape[-1] - 1))

@@ -126,6 +126,9 @@ def run_sweep(
     telemetry_path: Path | None = None,
     engine_cache: dict | None = None,
     chaos=None,
+    packed: bool = False,
+    progress=None,
+    use_all_devices: bool = True,
 ) -> list[dict]:
     """Run every point; returns (and optionally appends as JSONL) result dicts.
 
@@ -159,6 +162,24 @@ def run_sweep(
     the same plan just dies at the same point), which fills exactly the
     missing points (tests/test_chaos.py pins the refilled rows bit-equal to
     a fault-free sweep).
+
+    ``packed`` (tpu backend only — tpusim.packed) runs the grid as packed
+    device programs instead of per-point dispatches: points that agree in
+    program shape (tpusim.packed.pack_shape_key) share ONE compiled program
+    with their scenario parameters as per-run runtime tensors, and their
+    rows are BIT-equal to the sequential sweep (minus the wall-clock
+    fields). Fallback rules (README "Grid packing"): points with
+    ``rng="xoroshiro"`` or an armed flight recorder run sequentially, and
+    ``checkpoint_dir`` disables packing entirely (checkpoints are per-point
+    by construction) with a warning. Rows keep the exact schema and point
+    order either way.
+
+    ``progress(done_runs, total_runs)`` fires as runs complete, cumulative
+    over the WHOLE sweep (tpu backend; packed dispatches report per
+    dispatch) — the runner's callback contract, so a fleet worker's
+    heartbeat covers sub-grid units too. ``use_all_devices=False`` keeps
+    every point on one device (the fleet's ``--single-device`` lever for
+    workers sharing a host); packed dispatches are single-device either way.
     """
     import dataclasses
 
@@ -174,6 +195,16 @@ def run_sweep(
             f"run_sweep supports the 'tpu' and 'cpp' backends, got {backend!r} "
             f"(the pychain oracle returns raw chains, not SimResults)"
         )
+    if packed and backend != "tpu":
+        raise ValueError("packed sweeps need the tpu backend")
+    if packed and checkpoint_dir is not None:
+        import logging
+
+        logging.getLogger("tpusim").warning(
+            "packed sweeps have no per-point checkpoints; --checkpoint-dir "
+            "falls back to the sequential path"
+        )
+        packed = False
 
     done: set[tuple[str, int, str]] = set()
     if resume and out_path is not None and out_path.exists():
@@ -198,22 +229,38 @@ def run_sweep(
             chaos.bind_telemetry(recorder)
             recorder.chaos = chaos
 
-    results = []
-    for name, config in points:
-        runs = max(1, int(config.runs * runs_scale))
-        if (name, runs, backend) in done:
-            if not quiet:
-                print(f"[{name}] already in {out_path}; skipping")
-            continue
+    def emit_row(row: dict, runs: int) -> None:
+        if out_path is not None:
+            # Torn-trailing-line repair before every append (a killed window
+            # can cut the previous row mid-write) — the shared discipline of
+            # telemetry.append_jsonl_line, also used by the fleet ledger.
+            from .telemetry import append_jsonl_line
+
+            append_jsonl_line(out_path, json.dumps(row))
+        if recorder is not None:
+            recorder.emit(
+                "sweep_point", t_start=time.time() - row["elapsed_s"],
+                dur_s=row["elapsed_s"], point=row["point"], runs=runs,
+                backend=backend,
+            )
+        if not quiet:
+            print(f"[{row['point']}] done in {row['elapsed_s']}s ({runs} runs)")
+
+    def run_one(name: str, config: SimConfig) -> dict:
         if chaos is not None:
             # The poisoned-point seam: fires before any compute so a drill
-            # costs nothing, and fails loud — an operator resumes with
-            # --resume, which fills exactly the missing points.
+            # can poison one named point and fail loud — an operator resumes
+            # with --resume, which fills exactly the missing points.
             chaos.fire("sweep.point", target=name, backend=backend)
-        config = dataclasses.replace(config, runs=runs)
         t0 = time.monotonic()
         if backend == "tpu":
-            kwargs = {"engine_cache": engine_cache, "chaos": chaos}
+            kwargs = {"engine_cache": engine_cache, "chaos": chaos,
+                      "use_all_devices": use_all_devices}
+            if progress is not None:
+                base = runs_done_acc["n"]
+                kwargs["progress"] = (
+                    lambda d, t: progress(base + d, total_runs)
+                )
             if checkpoint_dir is not None:
                 checkpoint_dir.mkdir(parents=True, exist_ok=True)
                 kwargs["checkpoint_path"] = checkpoint_dir / f"{name}.npz"
@@ -227,28 +274,93 @@ def run_sweep(
         # Spread first: the sweep's own wall-clock (which includes checkpoint
         # setup and native build overhead) must win over the backend-internal
         # elapsed_s inside to_dict().
-        row = {
+        return {
             **res.to_dict(),
             "point": name,
             "backend": backend,
             "elapsed_s": round(time.monotonic() - t0, 3),
         }
-        results.append(row)
-        if out_path is not None:
-            # Torn-trailing-line repair before every append (a killed window
-            # can cut the previous row mid-write) — the shared discipline of
-            # telemetry.append_jsonl_line, also used by the fleet ledger.
-            from .telemetry import append_jsonl_line
 
-            append_jsonl_line(out_path, json.dumps(row))
-        if recorder is not None:
-            recorder.emit(
-                "sweep_point", t_start=time.time() - row["elapsed_s"],
-                dur_s=row["elapsed_s"], point=name, runs=runs, backend=backend,
+    pending: list[tuple[str, SimConfig]] = []
+    for name, config in points:
+        runs = max(1, int(config.runs * runs_scale))
+        if (name, runs, backend) in done:
+            if not quiet:
+                print(f"[{name}] already in {out_path}; skipping")
+            continue
+        pending.append((name, dataclasses.replace(config, runs=runs)))
+
+    sweep_t0 = time.monotonic()
+    rows_by_idx: dict[int, dict] = {}
+    flushed = 0
+    # Sweep-cumulative progress base: packs and points run serially, so a
+    # running offset turns their per-group callbacks into one monotone
+    # (done, total) stream for the caller's heartbeat.
+    total_runs = sum(cfg.runs for _, cfg in pending)
+    runs_done_acc = {"n": 0}
+
+    def flush() -> None:
+        # Rows land in POINT order (the fleet's buffered-flush rule): a row
+        # is appended only once every earlier point's row exists, so packed
+        # output files diff line-for-line against sequential ones. The
+        # sequential path completes points in order, so it still streams.
+        nonlocal flushed
+        while flushed < len(pending) and flushed in rows_by_idx:
+            emit_row(rows_by_idx[flushed], pending[flushed][1].runs)
+            flushed += 1
+
+    if packed and pending:
+        from .packed import plan_packs, run_grid
+
+        packs, sequential = plan_packs(pending)
+        for pack in packs:
+            # The per-point chaos seam still fires per point, before the
+            # pack's first compute — same drill surface as the sequential
+            # path (a poisoned point kills the whole pack, loud).
+            if chaos is not None:
+                for i in pack.indices:
+                    chaos.fire(
+                        "sweep.point", target=pending[i][0], backend=backend
+                    )
+            group = [pending[i] for i in pack.indices]
+            base = runs_done_acc["n"]
+            out = run_grid(
+                group, engine_cache=engine_cache, telemetry=recorder,
+                chaos=chaos,
+                progress=None if progress is None else (
+                    lambda d, t: progress(base + d, total_runs)
+                ),
             )
-        if not quiet:
-            print(f"[{name}] done in {row['elapsed_s']}s ({runs} runs)")
+            runs_done_acc["n"] = base + sum(cfg.runs for _, cfg in group)
+            for i, entry in zip(pack.indices, out):
+                rows_by_idx[i] = {
+                    **entry["results"].to_dict(),
+                    "point": entry["name"],
+                    "backend": backend,
+                    "elapsed_s": round(entry["elapsed_s"], 3),
+                }
+            flush()
+        for i in sequential:
+            rows_by_idx[i] = run_one(*pending[i])
+            runs_done_acc["n"] += pending[i][1].runs
+            flush()
+    else:
+        for i, (name, config) in enumerate(pending):
+            rows_by_idx[i] = run_one(name, config)
+            runs_done_acc["n"] += config.runs
+            flush()
+
+    results = [rows_by_idx[i] for i in range(len(pending))]
     if recorder is not None:
+        if packed:
+            # Packed grids never enter the runner, so nothing else emits the
+            # closing "run" span `tpusim watch` exits on — the sweep owns it
+            # (the fleet supervisor's discipline).
+            elapsed = time.monotonic() - sweep_t0
+            recorder.emit(
+                "run", t_start=time.time() - elapsed, dur_s=elapsed,
+                points=len(results), packed=True, backend=backend,
+            )
         recorder.close()
     return results
 
@@ -278,6 +390,14 @@ def main(argv: list[str] | None = None) -> int:
         "--telemetry", type=Path, metavar="JSONL",
         help="append one structured span ledger for the sweep here "
         "(render with `python -m tpusim report`)",
+    )
+    p.add_argument(
+        "--packed", action="store_true",
+        help="run shape-agreeing grid points as packed device programs "
+        "(tpusim.packed): one compiled program per shape group, scenario "
+        "params as per-run tensors, rows bit-equal to the sequential sweep "
+        "(xoroshiro/flight points fall back; incompatible with "
+        "--checkpoint-dir)",
     )
     p.add_argument("--quiet", action="store_true")
     p.add_argument(
@@ -343,6 +463,7 @@ def main(argv: list[str] | None = None) -> int:
         resume=args.resume,
         telemetry_path=args.telemetry,
         chaos=chaos,
+        packed=args.packed,
     )
     return 0
 

@@ -24,7 +24,7 @@ import sys
 import time
 from pathlib import Path
 
-from .convergence import format_num, snapshot_rows
+from .convergence import format_num, point_snapshot_rows, snapshot_rows
 from .report import format_bytes, text_table
 from .telemetry import BatchRecord, load_spans, throughput_report
 
@@ -179,7 +179,30 @@ def render_watch(spans: list[dict], source: str, now: float | None = None) -> st
 
     # --- Convergence (the stats spans this dashboard exists for).
     out.append("")
-    if sstats:
+    prows = point_snapshot_rows(sstats)
+    # A MIXED packed sweep carries both span kinds: per-point segments from
+    # the packed dispatches and plain spans from unpackable fallback points
+    # (xoroshiro/flight) that ran through the runner. Each renders from its
+    # own subset so no point's narrowing disappears.
+    blended = [
+        s for s in sstats
+        if not isinstance((s.get("attrs") or {}).get("point"), str)
+    ]
+    last_stats = (blended[-1].get("attrs") or {}) if blended else last_stats
+    if prows is not None:
+        # Packed sweep (tpusim.packed): the spans are per-POINT segments —
+        # render each grid point's own progress and CI narrowing instead of
+        # one blended run. Same shared extraction as the report panel.
+        target = last_stats.get("target_rel_hw")
+        title = "convergence by grid point (packed sweep"
+        if target is not None:
+            title += f", target rel hw {format_num(target)}"
+        out.append(title + "):")
+        out.extend(
+            text_table(["point", "runs", "rel hw95 (worst stat)", "status"], prows)
+        )
+    if blended:
+        sstats = blended
         target = last_stats.get("target_rel_hw")
         title = f"convergence (95% CI, n={last_stats.get('runs', '?')}"
         if target is not None:
@@ -209,7 +232,7 @@ def render_watch(spans: list[dict], source: str, now: float | None = None) -> st
             out.append(
                 f"  narrowing over {len(sstats)} batches: " + ", ".join(trends)
             )
-    else:
+    elif prows is None:
         out.append("convergence: no stats spans yet (run with --telemetry on a "
                    "tpusim version that emits them)")
 
