@@ -225,8 +225,11 @@ echo "== fleet kill-drill smoke =="
 # the closing span, and `tpusim report` must render the fleet panel.
 fleet_dir="$tele_dir/fleet"
 mkdir -p "$fleet_dir"
+# The drill supervisor's ledger lives INSIDE its state dir so the
+# orchestration-timeline leg below can merge supervisor + worker ledgers
+# from one root (`tpusim trace timeline STATE_DIR`).
 timeout 420 python -m tpusim watch --no-clear --interval 1 \
-  --wait-for-file 300 "$fleet_dir/fleet.tele.jsonl" > "$fleet_dir/watch.txt" &
+  --wait-for-file 300 "$fleet_dir/drill/fleet.tele.jsonl" > "$fleet_dir/watch.txt" &
 watch_pid=$!
 env JAX_PLATFORMS=cpu python -m tpusim.cli fleet propagation --max-points 2 \
   --runs-scale 3e-6 --batch-size 2 --workers 2 --single-device --no-probe \
@@ -234,7 +237,7 @@ env JAX_PLATFORMS=cpu python -m tpusim.cli fleet propagation --max-points 2 \
 env JAX_PLATFORMS=cpu python -m tpusim.cli fleet propagation --max-points 2 \
   --runs-scale 3e-6 --batch-size 2 --workers 2 --single-device --no-probe \
   --quiet --state-dir "$fleet_dir/drill" --lease-s 120 \
-  --telemetry "$fleet_dir/fleet.tele.jsonl" \
+  --telemetry "$fleet_dir/drill/fleet.tele.jsonl" \
   --worker-chaos drills/fleet-worker-kill.json --worker-chaos-point prop-100ms
 wait "$watch_pid"
 grep -q "fleet:" "$fleet_dir/watch.txt"
@@ -254,8 +257,38 @@ events = [json.loads(ln)["event"] for ln in open(sys.argv[3]) if ln.strip()]
 assert events.count("requeue") == 1 and events.count("quarantine") == 0, events
 print(f"fleet kill drill: {len(drill)} rows bit-equal after 1 requeue")
 EOF
-env JAX_PLATFORMS=cpu python -m tpusim report "$fleet_dir/fleet.tele.jsonl" \
+env JAX_PLATFORMS=cpu python -m tpusim report "$fleet_dir/drill/fleet.tele.jsonl" \
   | grep -q "Fleet (worker supervisor)"
+
+echo "== orchestration timeline (distributed tracing) =="
+# The cross-process span tree of the drill above (tpusim.tracing): merge the
+# supervisor + worker ledgers, render the critical-path attribution, export
+# the orchestration Perfetto trace — then gate the acceptance contract:
+# per-category attribution accounts for >= 90% of the supervisor-measured
+# fleet wall-clock (remainder explicit as "unattributed"), and the exported
+# trace passes the shared validate_perfetto schema check. Jax-free on
+# purpose: `trace timeline` must work on a host with no backend.
+python -m tpusim trace timeline "$fleet_dir/drill" \
+  --out "$fleet_dir/orchestration.trace.json" > "$fleet_dir/timeline.txt"
+grep -q "Wall-clock attribution (critical path)" "$fleet_dir/timeline.txt"
+grep -q "Per-worker utilization" "$fleet_dir/timeline.txt"
+python - "$fleet_dir/orchestration.trace.json" <<'EOF'
+import json, sys
+from tpusim.tracing import validate_perfetto
+trace = json.load(open(sys.argv[1]))
+n = validate_perfetto(trace)
+att = trace["otherData"]["attribution"]
+total = sum(att["categories"].values())
+assert abs(total - att["total_s"]) < 1e-6, (total, att["total_s"])
+assert att["coverage"] >= 0.9, f"attribution covers only {att['coverage']:.1%}: {att}"
+assert att["categories"]["backoff"] > 0, att  # the drill's requeue backoff
+print(f"orchestration trace: {n} events, {100 * att['coverage']:.1f}% of "
+      f"{att['total_s']:.1f}s fleet wall-clock attributed")
+EOF
+# The merged state-dir report renders the critical-path panel next to the
+# per-(run_id, process) throughput groups.
+env JAX_PLATFORMS=cpu python -m tpusim report "$fleet_dir/drill" \
+  | grep -q "Fleet time attribution (critical path)"
 
 echo "== flight-recorder trace smoke =="
 # One tiny flight-enabled run end-to-end: export the Perfetto trace + JSONL

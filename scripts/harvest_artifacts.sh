@@ -43,6 +43,17 @@ if [ -n "$newest" ]; then
     python -m tpusim report "$newest" --format md \
     --out artifacts/telemetry/sample_report.md > /dev/null
 fi
+# Orchestration timeline (tpusim.tracing): re-derive the committed sample
+# timeline + Perfetto trace from the committed sample fleet ledgers (a tiny
+# worker-kill drill's supervisor + worker telemetry under sample_fleet/), so
+# the evidence artifacts always match the current merger/exporter. Hardware
+# fleet runs rsync their STATE_DIRs next to it; every *.trace.json written
+# here is schema-validated by the block below. Jax-free.
+if [ -d artifacts/telemetry/sample_fleet ]; then
+  python -m tpusim trace timeline artifacts/telemetry/sample_fleet \
+    --format md --out artifacts/telemetry/sample.orchestration.trace.json \
+    > artifacts/telemetry/sample_timeline.md
+fi
 # Flight-recorder traces (`tpusim trace --trace-out` exports from hardware
 # windows land next to the ledgers): schema-validate whatever is collected so
 # a corrupt export can't sit silently in the evidence trail.

@@ -495,3 +495,79 @@ def test_drill_dashboards_render_fleet_panels(drill):
     assert "lease_expired" in report  # the requeue table names the reason
     watch = render_watch(spans, "drill")
     assert "fleet:" in watch and "5/5 points" in watch
+
+
+def test_drill_timeline_spans_one_correlated_tree(drill):
+    # Trace-context propagation end to end: every worker ledger under
+    # STATE_DIR/workers carries the supervisor's trace_id/run_id and the
+    # parent_span naming its fleet_spawn — one span tree for the whole fleet.
+    from tpusim.tracing import assemble, collect_spans
+
+    spans = collect_spans([drill.sup.state_dir])
+    trace = assemble(spans)
+    assert trace is not None
+    assert trace.trace_id == drill.sup.recorder.trace_id
+    assert trace.run_id == drill.sup.recorder.run_id
+    # One worker node per spawn (attempt-0 + its replacement for all 5
+    # drilled points), and every ATTEMPT'S process correlated via its own
+    # worker_start handshake.
+    assert len(trace.workers) == drill.summary["workers_spawned"] == 10
+    correlated = [w for w in trace.workers.values() if w.process is not None]
+    assert len(correlated) == 10
+
+
+def test_drill_timeline_attribution_and_critical_path(drill):
+    from tpusim.tracing import assemble, attribution, collect_spans
+
+    trace = assemble(collect_spans([drill.sup.state_dir]))
+    att = attribution(trace)
+    # The category seconds partition the supervisor-measured fleet window
+    # exactly; the remainder is explicit.
+    assert sum(att["categories"].values()) == pytest.approx(att["total_s"])
+    assert att["coverage"] >= 0.5  # the wedged (pt-hang) worker's frozen
+    # lease is honest dead time; the ci.sh kill-only drill gates >= 0.9
+    # The requeue backoff windows sit on the timeline...
+    assert any(iv.category == "backoff" for iv in trace.intervals)
+    # ...and so does the healing evidence: the REPLACEMENT workers that
+    # resumed a durable checkpoint show their checkpoint_load interval.
+    healer = {
+        e["point"]: e["worker"]
+        for e in events_of(drill.sup) if e["event"] == "done"
+    }
+    load_workers = {
+        iv.worker for iv in trace.intervals if iv.span == "checkpoint_load"
+    }
+    assert {healer["pt-kill-post"], healer["pt-hang"]} <= load_workers
+    # Real compile/dispatch work was attributed, not lumped into spawn.
+    cats = att["categories"]
+    assert cats["spawn"] > 0 and cats["compile"] + cats["dispatch"] > 0
+
+
+def test_drill_timeline_cli_and_perfetto_export(drill, tmp_path):
+    from tpusim.tracing import timeline_main, validate_perfetto
+
+    out = tmp_path / "orch.trace.json"
+    rc = timeline_main([str(drill.sup.state_dir), "--out", str(out)])
+    assert rc == 0
+    exported = json.loads(out.read_text())
+    assert validate_perfetto(exported) > 0
+    names = [ev.get("name") for ev in exported["traceEvents"]]
+    # One lease slice per worker attempt; the worker-side chaos faults of
+    # the drill plans land as instants.
+    assert sum(1 for x in names if str(x).startswith("lease ")) == 10
+    assert any(str(x).startswith("chaos ") for x in names)
+
+
+def test_drill_report_merged_state_dir_renders_attribution(drill):
+    # `tpusim report STATE_DIR` merges supervisor + worker ledgers: the
+    # fleet panel grows the attribution and per-worker utilization tables,
+    # and the shared fleet run_id partitions by (run_id, process) instead of
+    # blending ten workers' batch streams into one bogus panel.
+    from tpusim.tracing import collect_spans
+
+    spans = collect_spans([drill.sup.state_dir])
+    report = render_report(spans)
+    assert "Fleet time attribution (critical path)" in report
+    assert "Per-worker utilization" in report
+    assert "attributed" in report
+    assert report.count("Throughput — run") >= 10

@@ -1,0 +1,471 @@
+"""Fleet-wide distributed tracing (tpusim.tracing): context propagation,
+schema-v2 span stamping, clock rebasing, span-tree assembly, critical-path
+attribution, the orchestration Perfetto export and the report/watch surfaces.
+
+Everything here except the explicit hot-path pin is jax-free by design —
+the module under test must run on a host with no backend."""
+
+from __future__ import annotations
+
+import json
+import shutil
+from pathlib import Path
+
+import pytest
+
+from tpusim.report import render_report
+from tpusim.telemetry import SCHEMA_VERSION, TelemetryRecorder, load_spans
+from tpusim.tracing import (
+    TRACE_ENV,
+    TraceContext,
+    assemble,
+    attribution,
+    collect_spans,
+    critical_path,
+    perfetto_timeline,
+    render_timeline,
+    timeline_main,
+    validate_perfetto,
+    worker_utilization,
+)
+from tpusim.watch import render_watch
+
+
+# ---------------------------------------------------------------------------
+# Trace-context propagation + recorder stamping.
+
+
+def test_trace_context_env_round_trip():
+    ctx = TraceContext(trace_id="t1", parent_span="w003", run_id="r9")
+    back = TraceContext.from_env({TRACE_ENV: ctx.to_env()})
+    assert back == ctx
+    # Optional fields stay optional.
+    assert TraceContext.from_env({TRACE_ENV: '{"trace_id": "t"}'}) == TraceContext("t")
+
+
+def test_trace_context_malformed_env_is_tolerated():
+    # A worker must never die over its tracing: garbage reads as no context.
+    for raw in ("", "not json", "[]", '{"parent_span": "x"}', '{"trace_id": 3}'):
+        assert TraceContext.from_env({TRACE_ENV: raw}) is None
+    assert TraceContext.from_env({}) is None
+
+
+def test_recorder_stamps_schema_v2_fields(tmp_path):
+    rec = TelemetryRecorder(tmp_path / "t.jsonl")
+    rec.emit("batch", runs=4)
+    rec.close()
+    (sp,) = load_spans(tmp_path / "t.jsonl")
+    assert sp["schema"] == SCHEMA_VERSION
+    assert sp["trace_id"] == rec.run_id  # trace root: trace_id IS run_id
+    assert sp["process"] == rec.process and sp["process"].startswith("p")
+    assert isinstance(sp["t_mono"], float)
+    assert "parent_span" not in sp  # root spans carry no parent
+
+
+def test_recorder_adopts_env_context(tmp_path, monkeypatch):
+    ctx = TraceContext(trace_id="tr-abc", parent_span="w007", run_id="run-xyz")
+    monkeypatch.setenv(TRACE_ENV, ctx.to_env())
+    rec = TelemetryRecorder(tmp_path / "t.jsonl")
+    rec.emit("worker_start")
+    rec.close()
+    (sp,) = load_spans(tmp_path / "t.jsonl")
+    assert sp["run_id"] == "run-xyz"
+    assert sp["trace_id"] == "tr-abc"
+    assert sp["parent_span"] == "w007"
+    # An explicit run_id always wins over the context's.
+    rec2 = TelemetryRecorder(tmp_path / "t2.jsonl", run_id="mine")
+    assert rec2.run_id == "mine" and rec2.trace_id == "tr-abc"
+
+
+def test_versionless_ledger_still_loads_and_groups(tmp_path):
+    # A pre-tracing (schema v1) ledger: no t_mono/schema/process/trace_id.
+    path = tmp_path / "old.jsonl"
+    rows = [
+        {"run_id": "r", "span": "batch", "t_start": 10.0, "dur_s": 2.0,
+         "attrs": {"runs": 4}},
+        {"run_id": "r", "span": "run", "t_start": 8.0, "dur_s": 5.0,
+         "attrs": {"duration_ms": 86_400_000, "block_interval_s": 600.0}},
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    spans = load_spans(path)
+    assert len(spans) == 2
+    report = render_report(spans)
+    assert "Throughput" in report  # the (run_id, "") group renders as before
+    assert assemble(spans) is None  # no fleet spans -> nothing to correlate
+
+
+# ---------------------------------------------------------------------------
+# Handcrafted two-worker fleet: assembly, skew rebasing, attribution.
+
+RID = "ridfleet"
+
+
+def _mk(span, t_start, t_mono, dur, process, parent=None, **attrs):
+    row = {
+        "run_id": RID, "span": span, "t_start": t_start, "t_mono": t_mono,
+        "dur_s": dur, "schema": 2, "process": process, "trace_id": RID,
+        "attrs": attrs,
+    }
+    if parent is not None:
+        row["parent_span"] = parent
+    return row
+
+
+def _supervisor_spans():
+    # Supervisor clock: wall = mono + 49000. Fleet window [50000, 50020].
+    def sup(span, mono, dur=0.0, **attrs):
+        return _mk(span, 49000 + mono + dur, mono + dur, dur, "psup", **attrs)
+
+    return [
+        sup("fleet_spawn", 1001, worker="w000", target="pt-a", attempt=0),
+        sup("fleet_spawn", 1002, worker="w001", target="pt-b", attempt=0),
+        sup("fleet_requeue", 1010, worker="w000", target="pt-a",
+            reason="exit:-9", failures=1, backoff_s=2.0),
+        sup("fleet_done", 1012, worker="w001", target="pt-b", attempt=0),
+        sup("fleet_spawn", 1013, worker="w002", target="pt-a", attempt=1),
+        sup("fleet_quarantine", 1018, target="pt-zz", failures=3,
+            reason="exit:1"),
+        sup("fleet_done", 1019, worker="w002", target="pt-a", attempt=1),
+        sup("run", 1000, dur=20.0, fleet=True, points_done=2),
+    ]
+
+
+def _worker_spans():
+    # Helpers take the span's END on the process's own monotonic clock (the
+    # t_mono write-time convention). w000's wall clock runs 500 s BEHIND the
+    # supervisor — its raw t_start values would place it before its own
+    # spawn; true wall = 50001 + mono, reported wall = 49501 + mono.
+    def w0(span, mono_end, dur=0.0, **attrs):
+        return _mk(span, 49501.0 + mono_end, mono_end, dur, "pw0",
+                   parent="w000", **attrs)
+
+    # w001: honest clock, wall = mono + 49981.5 (spawned at 50002).
+    def w1(span, mono_end, dur=0.0, **attrs):
+        return _mk(span, 49981.5 + mono_end, mono_end, dur, "pw1",
+                   parent="w001", **attrs)
+
+    # w002 (the healer): honest clock, wall = mono + 50008.2 (spawn 50013).
+    def w2(span, mono_end, dur=0.0, **attrs):
+        return _mk(span, 50008.2 + mono_end, mono_end, dur, "pw2",
+                   parent="w002", **attrs)
+
+    return [
+        w0("worker_start", 0.2, pid=100, point="pt-a"),
+        w0("compile", 3.0, dur=0.5),                       # [50003.3, 50003.8]
+        w0("batch", 7.0, dur=3.5, runs=2, stall_s=0.5),    # [50004.3, 50007.8]
+        w0("checkpoint_save", 7.4, dur=0.3, runs_done=2),  # [50007.9, 50008.2]
+        w0("chaos", 7.5, point="checkpoint.save", kind="sigkill"),
+        w1("worker_start", 21.0, pid=101, point="pt-b"),   # 50002.5
+        w1("compile", 26.0, dur=1.0),                      # [50006.5, 50007.5]
+        w1("batch", 30.0, dur=6.0, runs=4, stall_s=1.0),   # [50005.5, 50011.5]
+        w1("run", 30.2, dur=9.0, runs=4),
+        w2("worker_start", 5.0, pid=102, point="pt-a"),    # 50013.2
+        w2("checkpoint_load", 7.0, dur=0.4, runs_done=2),  # [50014.8, 50015.2]
+        w2("batch", 10.5, dur=3.0, runs=2),                # [50015.7, 50018.7]
+        w2("run", 10.7, dur=5.5, runs=2),
+    ]
+
+
+@pytest.fixture()
+def fleet_spans():
+    return _supervisor_spans() + _worker_spans()
+
+
+def test_assemble_builds_the_span_tree(fleet_spans):
+    trace = assemble(fleet_spans)
+    assert trace is not None
+    assert trace.trace_id == RID and trace.run_id == RID
+    assert set(trace.workers) == {"w000", "w001", "w002"}
+    assert trace.workers["w000"].process == "pw0"
+    assert trace.workers["w002"].process == "pw2"
+    assert trace.workers["w000"].end_reason == "requeue"
+    assert trace.workers["w001"].end_reason == "done"
+    assert (trace.t0, trace.t1) == (50000.0, 50020.0)
+    # The quarantine and the worker's chaos fault land as instants.
+    assert {i["span"] for i in trace.instants} == {"chaos", "fleet_quarantine"}
+
+
+def test_clock_skew_rebased_on_the_spawn_handshake(fleet_spans):
+    trace = assemble(fleet_spans)
+    # w000's wall clock ran 500 s behind: the merger must shift the whole
+    # process forward so its handshake span sits at its fleet_spawn...
+    assert trace.processes["pw0"]["skew_s"] == pytest.approx(500.0, abs=0.5)
+    ws = next(
+        sp for sp in trace.spans
+        if sp["span"] == "worker_start" and sp["process"] == "pw0"
+    )
+    assert ws["_t1"] >= 50001.0 - 1e-6
+    # ...so no w000 span can precede the spawn and no duration is negative.
+    for sp in trace.spans:
+        assert sp["_t1"] >= sp["_t0"]
+        if sp["process"] == "pw0":
+            assert sp["_t0"] >= 50001.0 - 1e-6
+    # The honest clocks are NOT shifted.
+    assert trace.processes["pw1"]["skew_s"] == 0.0
+    assert trace.processes["pw2"]["skew_s"] == 0.0
+
+
+def test_stepped_wall_clock_cannot_reorder_a_timeline():
+    # One process whose wall clock steps BACKWARD 300 s mid-run while the
+    # monotonic readings advance: rebased order must follow t_mono.
+    spans = _supervisor_spans() + [
+        _mk("worker_start", 50001.3, 1.3, 0.0, "pw0", parent="w000"),
+        _mk("batch", 50004.0, 4.0, 2.0, "pw0", parent="w000", runs=2),
+        _mk("batch", 49706.5, 6.5, 2.0, "pw0", parent="w000", runs=2),  # step!
+    ]
+    trace = assemble(spans)
+    w0 = sorted(
+        (sp for sp in trace.spans if sp["process"] == "pw0"),
+        key=lambda sp: sp["_t0"],
+    )
+    assert [sp["t_mono"] for sp in w0] == sorted(sp["t_mono"] for sp in w0)
+    assert all(sp["_t1"] >= sp["_t0"] for sp in w0)
+
+
+def test_category_attribution_and_critical_path(fleet_spans):
+    trace = assemble(fleet_spans)
+    att = attribution(trace)
+    cats = att["categories"]
+    assert att["total_s"] == pytest.approx(20.0)
+    # Every category seconds sums exactly to the fleet window.
+    assert sum(cats.values()) == pytest.approx(20.0)
+    # The requeue backoff window is attributed...
+    assert cats["backoff"] == pytest.approx(2.0, abs=0.2)
+    # ...spawn covers process start -> first work, per worker...
+    assert cats["spawn"] > 2.0
+    # ...the pre-spawn setup and the post-fleet drain are supervisor idle...
+    assert cats["supervisor_idle"] >= 1.0
+    # ...and the remainder is explicit and small here.
+    assert cats["unattributed"] < 2.0
+    assert att["coverage"] > 0.9
+    # The healer's checkpoint_load sits ON the timeline (the healing
+    # evidence): a checkpoint interval from pw2 exists and the critical
+    # path walk covers the window end-to-end.
+    assert any(
+        iv.category == "checkpoint" and iv.span == "checkpoint_load"
+        and iv.process == "pw2"
+        for iv in trace.intervals
+    )
+    segs = critical_path(trace)
+    assert segs[0].start == pytest.approx(trace.t0)
+    assert segs[-1].end == pytest.approx(trace.t1)
+    for a, b in zip(segs, segs[1:]):
+        assert b.start == pytest.approx(a.end)
+
+
+def test_batch_intervals_carve_out_compile_and_stall(fleet_spans):
+    trace = assemble(fleet_spans)
+    w1 = [iv for iv in trace.intervals if iv.process == "pw1"]
+    stall = [iv for iv in w1 if iv.category == "host_stall"]
+    assert len(stall) == 1 and stall[0].end - stall[0].start == pytest.approx(1.0)
+    # w1's compile [50006.5, 50007.5] lies inside its batch [50005.5,
+    # 50011.5]: the dispatch pieces must not double-cover it.
+    compile_iv = next(iv for iv in w1 if iv.category == "compile")
+    for iv in w1:
+        if iv.category == "dispatch":
+            assert iv.end <= compile_iv.start + 1e-9 or iv.start >= compile_iv.end - 1e-9
+
+
+def test_worker_utilization_rows(fleet_spans):
+    trace = assemble(fleet_spans)
+    rows = {r["worker"]: r for r in worker_utilization(trace)}
+    assert rows["w001"]["point"] == "pt-b" and rows["w001"]["end_reason"] == "done"
+    assert rows["w001"]["alive_s"] == pytest.approx(10.0)  # spawn 1002 -> done 1012
+    assert 0.0 < rows["w001"]["utilization"] <= 1.0
+    assert set(rows["w001"]["by_category"]) >= {"dispatch", "compile", "spawn"}
+    # Supervisor-only ledger (tpusim watch's view): lease windows known,
+    # busy unknown — rendered n/a, never invented.
+    sup_only = assemble(_supervisor_spans())
+    rows2 = worker_utilization(sup_only)
+    assert all(r["busy_s"] is None and r["utilization"] is None for r in rows2)
+
+
+# ---------------------------------------------------------------------------
+# Ledger collection: directory scan, dedupe, torn/foreign tolerance.
+
+
+def _write_ledgers(root: Path, fleet_spans) -> Path:
+    (root / "workers").mkdir(parents=True, exist_ok=True)
+    by_proc: dict[str, list[dict]] = {}
+    for sp in fleet_spans:
+        by_proc.setdefault(sp["process"], []).append(sp)
+    for proc, group in by_proc.items():
+        name = "fleet.tele.jsonl" if proc == "psup" else f"workers/{proc}.tele.jsonl"
+        (root / name).write_text(
+            "".join(json.dumps(sp) + "\n" for sp in group)
+        )
+    return root
+
+
+def test_collect_spans_merges_dedupes_and_tolerates_foreign(tmp_path, fleet_spans):
+    root = _write_ledgers(tmp_path / "state", fleet_spans)
+    # Foreign JSONL files a real state dir holds: the fleet work ledger
+    # (event rows), heartbeat files, sweep rows — plus a torn trailing line.
+    (root / "fleet-ledger.jsonl").write_text(
+        '{"event": "lease", "point": "pt-a", "t": 1.0}\n{"event": "done"'
+    )
+    (root / "workers" / "w000.hb.jsonl").write_text('{"t": 1.0, "beats": 3}\n')
+    (root / "rows.jsonl").write_text('{"point": "pt-a", "runs": 4}\n')
+    with (root / "fleet.tele.jsonl").open("a") as fh:
+        fh.write('{"run_id": "x", "span": "batc')  # torn mid-write
+    spans = collect_spans([root])
+    assert len(spans) == len(fleet_spans)
+    # The supervisor ledger passed AGAIN explicitly must not double-count.
+    spans2 = collect_spans([root, root / "fleet.tele.jsonl"])
+    assert len(spans2) == len(spans)
+    # A copied ledger inside the dir (an artifact harvest) dedupes too.
+    shutil.copy(root / "fleet.tele.jsonl", root / "copy.tele.jsonl")
+    assert len(collect_spans([root])) == len(spans)
+
+
+def test_assemble_tolerates_partial_and_foreign_spans(fleet_spans):
+    # Attribute-less, t_mono-less and unknown spans must degrade, not raise.
+    spans = fleet_spans + [
+        {"run_id": RID, "span": "mystery", "t_start": 50003.0, "dur_s": 0.5,
+         "trace_id": RID, "process": "pw1", "attrs": None},
+        {"run_id": RID, "span": "batch", "t_start": 50004.0, "dur_s": 0.0,
+         "trace_id": RID, "process": "pother"},  # no parent, no t_mono
+        {"span": "orphan"},
+    ]
+    trace = assemble(spans)
+    assert trace is not None
+    assert attribution(trace)["total_s"] == pytest.approx(20.0)
+    render_timeline(trace)  # renders without raising
+
+
+# ---------------------------------------------------------------------------
+# Perfetto export + CLI.
+
+
+def test_perfetto_timeline_validates_and_carries_the_tree(fleet_spans):
+    trace = assemble(fleet_spans)
+    exported = perfetto_timeline(trace)
+    n = validate_perfetto(exported)
+    assert n > 0
+    assert exported["otherData"]["trace_id"] == RID
+    assert exported["otherData"]["attribution"]["coverage"] > 0.9
+    names = [ev.get("name") for ev in exported["traceEvents"]]
+    # One lease slice per worker, a backoff slice, and the fault instants.
+    assert sum(1 for x in names if str(x).startswith("lease ")) == 3
+    assert "requeue backoff" in names
+    assert any(str(x).startswith("chaos ") for x in names)
+    assert "fleet_quarantine" in names
+    # Slices are X events with numeric dur (the validator now requires it).
+    assert all(
+        isinstance(ev.get("dur"), int)
+        for ev in exported["traceEvents"] if ev.get("ph") == "X"
+    )
+
+
+def test_validate_perfetto_rejects_x_without_dur():
+    bad = {"traceEvents": [
+        {"ph": "X", "name": "s", "ts": 1, "pid": 0, "tid": 0},
+    ]}
+    with pytest.raises(ValueError, match="dur"):
+        validate_perfetto(bad)
+
+
+def test_timeline_cli_end_to_end(tmp_path, fleet_spans, capsys):
+    root = _write_ledgers(tmp_path / "state", fleet_spans)
+    out = tmp_path / "orch.trace.json"
+    rc = timeline_main([str(root), "--out", str(out)])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "Wall-clock attribution (critical path)" in text
+    assert "backoff" in text and "checkpoint" in text
+    assert "clock skew corrected" in text  # pw0's +500 s shift is narrated
+    exported = json.loads(out.read_text())
+    assert validate_perfetto(exported) > 0
+
+
+def test_timeline_cli_errors(tmp_path, capsys):
+    assert timeline_main([str(tmp_path / "nope")]) == 2
+    # A dir with ledgers but no fleet spans: nothing to correlate.
+    led = tmp_path / "plain.jsonl"
+    led.write_text(json.dumps(
+        {"run_id": "r", "span": "batch", "t_start": 1.0, "dur_s": 1.0}
+    ) + "\n")
+    assert timeline_main([str(tmp_path)]) == 2
+    assert "no fleet trace" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# Report / watch surfaces.
+
+
+def test_report_partitions_by_run_id_and_process(fleet_spans):
+    # THE regression guard for merged fleet ledgers: every process of a
+    # traced fleet shares ONE run_id, so a bare run_id grouping would blend
+    # (and double-count) the workers' batch streams into one panel.
+    report = render_report(fleet_spans)
+    assert report.count("Throughput — run") == 3  # one per worker process
+    assert f"{RID} · pw0" in report and f"{RID} · pw1" in report
+    # Each panel derives from ITS worker's batches only (1 batch each).
+    assert '| batches' not in report  # text mode sanity
+    for line in report.splitlines():
+        if line.strip().startswith("batches"):
+            assert line.split()[-1] == "1"
+
+
+def test_report_merged_fleet_dir_renders_attribution(tmp_path, fleet_spans):
+    root = _write_ledgers(tmp_path / "state", fleet_spans)
+    from tpusim.report import main as report_main
+
+    assert report_main([str(root)]) == 0
+    report = render_report(collect_spans([root]))
+    assert "Fleet time attribution (critical path)" in report
+    assert "Per-worker utilization" in report
+    assert "attributed" in report
+    # The duplicate-ledger dedupe keeps the phase breakdown honest.
+    shutil.copy(root / "fleet.tele.jsonl", root / "copy.tele.jsonl")
+    assert render_report(collect_spans([root])) == report
+
+
+def test_watch_renders_worker_lease_utilization(fleet_spans):
+    frame = render_watch(_supervisor_spans(), "sup.jsonl", now=50021.0)
+    assert "worker leases (share of fleet window):" in frame
+    assert "w001 pt-b 10.0s" in frame
+    # And the full merged view still renders (watch is jax-free, so is this).
+    render_watch(fleet_spans, "merged", now=50021.0)
+
+
+# ---------------------------------------------------------------------------
+# Hot-path pin: tracing armed changes NOTHING the device sees.
+
+
+def test_device_hot_path_byte_identical_with_tracing_armed(tmp_path, monkeypatch):
+    import jax
+
+    from tpusim.config import SimConfig, default_network
+    from tpusim.engine import Engine
+    from tpusim.runner import make_run_keys
+    from tpusim.testing import compile_count_guard
+
+    cfg = SimConfig(
+        network=default_network(), duration_ms=86_400_000, runs=4,
+        batch_size=4, chunk_steps=64,
+    )
+    keys = make_run_keys(0, 0, 4)
+
+    def loop_jaxpr():
+        eng = Engine(cfg)
+        hi, lo = eng._ledger_init(4)
+        return str(jax.make_jaxpr(
+            lambda k: eng._device_loop(k, hi, lo, eng.params)
+        )(keys))
+
+    plain = loop_jaxpr()
+    monkeypatch.setenv(
+        TRACE_ENV,
+        TraceContext(trace_id="t", parent_span="w000", run_id="r").to_env(),
+    )
+    rec = TelemetryRecorder(tmp_path / "t.jsonl")
+    rec.emit("worker_start")
+    armed = loop_jaxpr()
+    assert armed == plain
+    eng = Engine(cfg)
+    eng.run_batch(keys)
+    with compile_count_guard(exact=0):
+        eng.run_batch(keys)
+        rec.emit("batch", runs=4)
+    rec.close()

@@ -14,6 +14,7 @@ Examples:
     python -m tpusim watch artifacts/telemetry/run.jsonl
     python -m tpusim trace --runs 4 --days 2 --trace-out flight.trace.json
     python -m tpusim trace diff jax_events.jsonl native_events.jsonl
+    python -m tpusim trace timeline fleet/ --out orchestration.trace.json
     python -m tpusim perf run --quick
     python -m tpusim perf compare artifacts/perf/calibration_cpu.jsonl new.jsonl
     python -m tpusim fleet propagation --workers 4 --state-dir fleet/
@@ -214,6 +215,14 @@ def main(argv: list[str] | None = None) -> int:
 
         return lint_main(argv[1:])
     if argv and argv[0] == "trace":
+        if len(argv) > 1 and argv[1] == "timeline":
+            # `trace timeline` merges ledgers a fleet already wrote — it is
+            # jax-free by design (tpusim.tracing) and must stay usable on a
+            # host with no backend, so it dispatches BEFORE the flight
+            # exporter (whose module import pulls the device recorder).
+            from .tracing import timeline_main
+
+            return timeline_main(argv[2:])
         # Same dispatch rule: run with the event flight recorder enabled and
         # export a Perfetto timeline / JSONL event log (tpusim.flight_export).
         from .flight_export import main as trace_main

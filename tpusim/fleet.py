@@ -51,6 +51,13 @@ diffs clean against a single-process sweep. Only the NamedSharding SPMD
 dispatch of the fleet item rides the next TPU window; everything here runs
 today.
 
+A supervisor running with ``--telemetry`` is also a trace root
+(tpusim.tracing): each spawn injects ``TPUSIM_TRACE_CONTEXT`` into the
+worker's environment, so the supervisor ledger plus every per-worker ledger
+under ``STATE_DIR/workers`` form ONE correlatable span tree —
+``tpusim trace timeline STATE_DIR`` renders the cross-process critical-path
+attribution and the orchestration Perfetto timeline from them.
+
     python -m tpusim fleet propagation --workers 4 --state-dir fleet/ \\
         --telemetry fleet/fleet.tele.jsonl
     python -m tpusim fleet propagation --workers 4 --state-dir fleet/ --resume
@@ -75,6 +82,7 @@ from typing import Any, Callable, Iterable
 from .chaos import ChaosError, ChaosInjector, ChaosPlan, InjectedHang, as_injector
 from .config import SimConfig
 from .telemetry import TelemetryRecorder, append_jsonl_line
+from .tracing import TRACE_ENV, TraceContext
 
 logger = logging.getLogger("tpusim")
 
@@ -241,6 +249,20 @@ def worker_main(argv: list[str] | None = None) -> int:
     injector = ChaosInjector(ChaosPlan.from_json(plan_text)) if plan_text else None
     hb = _Heartbeat(args.heartbeat, args.heartbeat_s, chaos=injector)
     hb.start()  # first beat BEFORE the jax import: the lease covers startup
+
+    if args.telemetry is not None:
+        # The clock-handshake span (tpusim.tracing): emitted BEFORE the jax
+        # import so the merger can anchor this process's monotonic clock to
+        # the supervisor's spawn span — everything between fleet_spawn and
+        # the first real work span is then honestly attributed as spawn cost
+        # (interpreter + jax import + engine build). The recorder adopts the
+        # supervisor's trace context from TPUSIM_TRACE_CONTEXT by itself.
+        hs = TelemetryRecorder(args.telemetry)
+        hs.emit(
+            "worker_start", pid=os.getpid(),
+            point=args.point, grid=str(args.grid) if args.grid else None,
+        )
+        hs.close()
 
     t0 = time.monotonic()
     if args.grid is not None:
@@ -584,6 +606,22 @@ class FleetSupervisor:
             env[WORKER_CHAOS_ENV] = plan.to_json()
         else:
             env.pop(WORKER_CHAOS_ENV, None)
+        if self.recorder is not None:
+            # Trace-context propagation (tpusim.tracing): the worker's
+            # recorder adopts the supervisor's trace_id AND run_id (so the
+            # whole fleet is one correlatable tree — and one run in every
+            # run_id-grouping surface, which is why tpusim.report partitions
+            # by (run_id, process)); parent_span is the worker id of THIS
+            # fleet_spawn span.
+            env[TRACE_ENV] = TraceContext(
+                trace_id=self.recorder.trace_id, parent_span=wid,
+                run_id=self.recorder.run_id,
+            ).to_env()
+        else:
+            # No supervisor ledger -> no span to parent to; a context
+            # inherited from an OUTER traced process would correlate workers
+            # to a spawn span that does not exist.
+            env.pop(TRACE_ENV, None)
         argv = (self.worker_cmd or self._default_worker_cmd)(asg)
         asg["result_path"].unlink(missing_ok=True)
         with asg["log_path"].open("ab") as log:

@@ -49,6 +49,10 @@ from typing import Any
 import numpy as np
 
 from .flight import FLIGHT_TIME_BASE, KIND_NAMES, N_FIELDS
+# The schema gate and artifact writer live in the jax-free tracing module
+# (the orchestration timeline shares both and must not pull a backend in);
+# re-exported here for the existing consumers (tests, CI, harvest).
+from .tracing import _write_artifact, validate_perfetto
 
 __all__ = [
     "FlightLog", "decode_flight", "events_jsonl", "perfetto_trace",
@@ -151,34 +155,6 @@ def perfetto_trace(
     if meta:
         other.update(meta)
     return {"traceEvents": tev, "displayTimeUnit": "ms", "otherData": other}
-
-
-def validate_perfetto(trace: Any) -> int:
-    """Schema check for the exported trace (used by CI's smoke leg and the
-    tests): raises ValueError on any violation, returns the number of
-    non-metadata events."""
-    if not isinstance(trace, dict) or not isinstance(trace.get("traceEvents"), list):
-        raise ValueError("trace must be a dict with a traceEvents list")
-    n = 0
-    for ev in trace["traceEvents"]:
-        if not isinstance(ev, dict) or "ph" not in ev:
-            raise ValueError(f"trace event without ph: {ev!r}")
-        if ev["ph"] == "M":
-            if "name" not in ev:
-                raise ValueError(f"metadata event without name: {ev!r}")
-            continue
-        if ev["ph"] not in ("i", "I", "X"):
-            raise ValueError(f"unexpected phase {ev['ph']!r}")
-        if not isinstance(ev.get("ts"), (int, float)) or ev["ts"] < 0:
-            raise ValueError(f"event without numeric ts: {ev!r}")
-        if not isinstance(ev.get("pid"), int) or not isinstance(ev.get("tid"), int):
-            raise ValueError(f"event without integer pid/tid: {ev!r}")
-        if ev["ph"] == "i" and ev.get("s") not in ("t", "p", "g"):
-            raise ValueError(f"instant event without scope: {ev!r}")
-        if not isinstance(ev.get("name"), str):
-            raise ValueError(f"event without name: {ev!r}")
-        n += 1
-    return n
 
 
 @dataclasses.dataclass
@@ -297,24 +273,6 @@ def diff_main(argv: list[str] | None = None) -> int:
     return 1 if diff.divergent else 0
 
 
-def _write_artifact(path: Path, text: str) -> None:
-    """Write one export artifact, failing CLEAN on a torn write: a half-
-    written trace JSON (ENOSPC, yanked volume) parses as nothing yet still
-    looks like a deliverable, so the partial file is removed and the error
-    reported as one line instead of a stack trace."""
-    path.parent.mkdir(parents=True, exist_ok=True)
-    try:
-        path.write_text(text)
-    except OSError as e:
-        try:
-            path.unlink(missing_ok=True)
-        except OSError:
-            pass
-        raise SystemExit(
-            f"error: writing {path} failed ({e}); partial file removed"
-        ) from None
-
-
 def main(argv: list[str] | None = None) -> int:
     """``tpusim trace``: run a (small) simulation with the flight recorder on
     and export the ring as Perfetto JSON + optional JSONL event log. Accepts
@@ -327,6 +285,13 @@ def main(argv: list[str] | None = None) -> int:
         # `tpusim trace diff A.jsonl B.jsonl`: compare two already-exported
         # event logs instead of producing one.
         return diff_main(argv[1:])
+    if argv and argv[0] == "timeline":
+        # `tpusim trace timeline STATE_DIR`: the cross-process orchestration
+        # timeline (tpusim.tracing). Normally dispatched jax-free straight
+        # from the umbrella CLI; this branch covers direct module use.
+        from .tracing import timeline_main
+
+        return timeline_main(argv[1:])
 
     p = build_parser()
     p.prog = "tpusim trace"

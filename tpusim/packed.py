@@ -536,11 +536,41 @@ def run_grid(
         )
 
     t0 = time.monotonic()
-    eng = _make_packed_engine(
-        members, engine=engine, engine_cache=engine_cache,
-        pack_width=pack_width, pallas_kwargs=pallas_kwargs,
-    )
-    eng.chaos = chaos
+    # Compile observability for the packed path (the runner arms this for
+    # sequential dispatches; packed grids never enter the runner): every XLA
+    # compile a packed grid pays lands as a `compile` span in the same
+    # ledger — which is also what lets the fleet timeline (tpusim.tracing)
+    # attribute a packed worker's first-dispatch wall-clock to compile
+    # instead of lumping it into dispatch.
+    compile_ledger = None
+    if telemetry is not None:
+        from .telemetry import CompileLedger
+
+        compile_ledger = CompileLedger(telemetry).install()
+        compile_ledger.set_context(dispatch="packed_grid")
+    try:
+        eng = _make_packed_engine(
+            members, engine=engine, engine_cache=engine_cache,
+            pack_width=pack_width, pallas_kwargs=pallas_kwargs,
+        )
+        eng.chaos = chaos
+        if compile_ledger is not None:
+            compile_ledger.set_context(engine=type(eng).__name__)
+        return _run_grid_dispatches(
+            eng, members, names, pack_width=pack_width,
+            host_loop=host_loop, pipelined=pipelined,
+            engine_cache=engine_cache, telemetry=telemetry,
+            progress=progress, t0=t0,
+        )
+    finally:
+        if compile_ledger is not None:
+            compile_ledger.uninstall()
+
+
+def _run_grid_dispatches(
+    eng, members, names, *, pack_width, host_loop, pipelined,
+    engine_cache, telemetry, progress, t0,
+) -> list[dict[str, Any]]:
     m = members[0].network.n_miners
 
     # Pieces in point order, cut at each point's own batch boundaries.
@@ -584,8 +614,12 @@ def run_grid(
         if progress is not None:
             progress(runs_done, total)
         if telemetry is not None:
+            dur_d = time.monotonic() - t_d
             telemetry.emit(
-                "packed_dispatch", dur_s=round(time.monotonic() - t_d, 6),
+                # Backdated start: the default t_start would stamp the END
+                # and misplace the interval on the raw wall axis.
+                "packed_dispatch", t_start=time.time() - dur_d,
+                dur_s=round(dur_d, 6),
                 dispatch=di, dispatches=len(dispatches), width=width,
                 runs=sum(p.count for p in batch), pieces=len(batch),
                 points=len({p.point for p in batch}),

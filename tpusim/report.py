@@ -1,6 +1,6 @@
 """``tpusim report`` — render a telemetry ledger into a dashboard.
 
-Two input kinds, auto-detected:
+Three input kinds, auto-detected:
 
   * a telemetry **JSONL file** written by ``--telemetry`` (tpusim.telemetry):
     rendered into a terminal/markdown dashboard — phase breakdown, steady-
@@ -17,6 +17,13 @@ Two input kinds, auto-detected:
     statistic, the ETA-to-target extrapolation, and the CI-narrowing
     trajectory across batches. ``tpusim watch`` is this dashboard's live
     twin for a still-growing ledger;
+  * a **fleet state dir** (any directory WITHOUT XLA trace files): every
+    ``*.jsonl`` telemetry ledger under it — the supervisor's plus each
+    worker's — is merged (deduplicated) into one dashboard. A traced fleet
+    shares one ``run_id`` across all its processes (tpusim.tracing), so the
+    throughput/convergence panels partition by ``(run_id, process)``, and
+    the fleet panel grows the cross-process time-attribution and per-worker
+    utilization tables;
   * an XLA **trace directory** written by ``--trace-dir``: offline op-level
     time attribution from the chrome-trace JSON inside — no TensorBoard
     needed (absorbed from the former scripts/trace_report.py; that script is
@@ -97,6 +104,21 @@ def _stall_histogram(stalls: list[float]) -> list[tuple[str, int]]:
         labels.append(f"{_fmt_s(lo)} - {hi_lbl}" if lo else f"< {hi_lbl}")
         counts.append(n)
     return list(zip(labels, counts))
+
+
+def _group_key(sp: dict) -> tuple[str, str]:
+    """The per-run partition key of the derived panels: ``(run_id,
+    process)``. One traced fleet shares one run_id across the supervisor and
+    every worker (tpusim.tracing), so run_id alone would blend N processes'
+    span streams; versionless spans (no ``process``) key on ``""`` and group
+    exactly as before."""
+    return str(sp.get("run_id", "?")), str(sp.get("process") or "")
+
+
+def _group_label(key: tuple[str, str], groups: dict) -> str:
+    rid, proc = key
+    same_rid = sum(1 for k in groups if k[0] == rid)
+    return f"{rid} · {proc}" if proc and same_rid > 1 else rid
 
 
 def _phase_rows(spans: list[dict]) -> list[tuple[str, int, float]]:
@@ -210,21 +232,25 @@ def render_report(spans: list[dict], fmt: str = "text") -> str:
         out.append("  no data — ledger has no batch spans")
     if batches:
         # An appended ledger can hold several runs (repeated --telemetry to
-        # one file); throughput must derive per run_id — the first-batch
-        # (compile) exclusion and the duration_ms lookup are per-run facts,
-        # and mixing runs would count every later run's compile batch as
-        # steady state.
+        # one file); throughput must derive per (run_id, process) — the
+        # first-batch (compile) exclusion and the duration_ms lookup are
+        # per-run facts, and mixing runs would count every later run's
+        # compile batch as steady state. The process half of the key exists
+        # for MERGED fleet ledgers: every worker of a traced fleet shares
+        # the supervisor's run_id (tpusim.tracing), so a bare run_id group
+        # would interleave N workers' batch streams into one bogus record
+        # list — and double-count every repeated (healed) point's work.
         run_attrs = {
-            sp.get("run_id"): sp.get("attrs", {})
+            _group_key(sp): sp.get("attrs", {})
             for sp in spans if sp["span"] == "run"
         }
-        groups: dict[str, list[dict]] = {}
+        groups: dict[tuple[str, str], list[dict]] = {}
         for sp in batches:
-            groups.setdefault(sp.get("run_id", "?"), []).append(sp)
-        for rid, group in groups.items():
+            groups.setdefault(_group_key(sp), []).append(sp)
+        for key, group in groups.items():
             heading(
                 "Throughput (batch spans)" if len(groups) == 1
-                else f"Throughput — run {rid}"
+                else f"Throughput — run {_group_label(key, groups)}"
             )
             records = [
                 BatchRecord(
@@ -233,7 +259,7 @@ def render_report(spans: list[dict], fmt: str = "text") -> str:
                 )
                 for sp in group
             ]
-            a = run_attrs.get(rid, {})
+            a = run_attrs.get(key, {})
             # duration_ms/block_interval_s ride on the run span; without one
             # (partial ledger) only run-rate is derivable.
             if "duration_ms" in a:
@@ -392,16 +418,18 @@ def render_report(spans: list[dict], fmt: str = "text") -> str:
     if sstats:
         # Convergence panels (the per-batch `stats` spans of
         # tpusim.convergence): final CI state + the narrowing trajectory.
-        # Grouped per run_id like throughput — an appended ledger (or a
-        # sweep, which shares one run_id across points) renders each
-        # segment's own trajectory; a run-count drop inside one group marks
-        # a new accumulator (next sweep point).
+        # Grouped per (run_id, process) like throughput — an appended ledger
+        # (or a sweep, which shares one run_id across points) renders each
+        # segment's own trajectory, a merged fleet ledger each WORKER's own
+        # (they share the supervisor's run_id); a run-count drop inside one
+        # group marks a new accumulator (next sweep point).
         from .convergence import format_num, point_snapshot_rows, snapshot_rows
 
-        sgroups: dict[str, list[dict]] = {}
+        sgroups: dict[tuple[str, str], list[dict]] = {}
         for sp in sstats:
-            sgroups.setdefault(sp.get("run_id", "?"), []).append(sp)
-        for rid, group in sgroups.items():
+            sgroups.setdefault(_group_key(sp), []).append(sp)
+        for key, group in sgroups.items():
+            rid = _group_label(key, sgroups)
             prow = point_snapshot_rows(group)
             if prow is not None:
                 # Packed sweep: the spans are per-POINT segments
@@ -500,6 +528,37 @@ def render_report(spans: list[dict], fmt: str = "text") -> str:
                     for l in fleet["leases"]
                 ],
             )
+
+        # Cross-process time attribution (tpusim.tracing): where the fleet's
+        # wall-clock went, on the critical path — spawn/compile/dispatch/
+        # stall/checkpoint/backoff/idle, remainder explicit — plus per-worker
+        # occupancy. Full category detail needs the worker ledgers merged in
+        # (`tpusim report STATE_DIR`); a supervisor-only ledger still gets
+        # the lease-level utilization rows. The row builders are SHARED with
+        # `tpusim trace timeline` (tpusim.tracing), so the two surfaces
+        # cannot drift.
+        from .tracing import (
+            ATTRIBUTION_HEADERS,
+            UTILIZATION_HEADERS,
+            assemble,
+            attribution,
+            attribution_footer,
+            attribution_rows,
+            utilization_rows,
+        )
+
+        trace = assemble(spans)
+        if trace is not None:
+            correlated = any(
+                node.process is not None for node in trace.workers.values()
+            )
+            if correlated:
+                att = attribution(trace)
+                heading("Fleet time attribution (critical path)")
+                table(ATTRIBUTION_HEADERS, attribution_rows(att))
+                out.append("  " + attribution_footer(att))
+            heading("Per-worker utilization")
+            table(UTILIZATION_HEADERS, utilization_rows(trace))
 
     faults = [sp for sp in spans if sp["span"] == "chaos"]
     if faults:
@@ -626,7 +685,27 @@ def main(argv: list[str] | None = None) -> int:
         print(f"error: {args.path} does not exist", file=sys.stderr)
         return 2
     if args.path.is_dir():
-        text = trace_attribution(args.path, top=args.top, track_filter=args.track_filter)
+        if find_trace_files(args.path):
+            # XLA trace directory (--trace-dir output): op-level attribution.
+            text = trace_attribution(
+                args.path, top=args.top, track_filter=args.track_filter
+            )
+        else:
+            # A fleet state dir (or any directory of telemetry ledgers):
+            # merge every *.jsonl ledger under it — supervisor + workers —
+            # deduplicated, and render ONE dashboard over the union; the
+            # per-run panels partition by (run_id, process) so the shared
+            # fleet run_id cannot blend worker streams.
+            from .tracing import collect_spans
+
+            spans = collect_spans([args.path])
+            if not spans:
+                print(
+                    f"error: {args.path} holds neither XLA trace files nor "
+                    f"telemetry ledgers", file=sys.stderr,
+                )
+                return 2
+            text = render_report(spans, fmt=args.format)
     else:
         text = render_report(load_spans(args.path), fmt=args.format)
     try:

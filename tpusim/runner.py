@@ -430,9 +430,14 @@ def run_simulation_config(
                 runs_done, sums = loaded
                 logger.info("resuming from checkpoint at %d/%d runs", runs_done, config.runs)
                 if telemetry is not None:
+                    # Backdated like the batch spans: a default t_start would
+                    # stamp the span's END and place the interval in the
+                    # future on the wall axis (the timeline merger rebases on
+                    # t_mono either way, but the raw ledger should not lie).
+                    dur_ld = time.perf_counter() - t_ld
                     telemetry.emit(
-                        "checkpoint_load", dur_s=time.perf_counter() - t_ld,
-                        runs_done=runs_done, path=str(ckpt.path),
+                        "checkpoint_load", t_start=time.time() - dur_ld,
+                        dur_s=dur_ld, runs_done=runs_done, path=str(ckpt.path),
                     )
 
         t0 = time.monotonic()
@@ -707,9 +712,10 @@ def run_simulation_config(
                     t_ck = time.perf_counter()
                     ckpt.save(runs_done, sums)
                     if telemetry is not None:
+                        dur_ck = time.perf_counter() - t_ck
                         telemetry.emit(
-                            "checkpoint_save", dur_s=time.perf_counter() - t_ck,
-                            runs_done=runs_done, path=str(ckpt.path),
+                            "checkpoint_save", t_start=time.time() - dur_ck,
+                            dur_s=dur_ck, runs_done=runs_done, path=str(ckpt.path),
                         )
                 if progress is not None:
                     progress(runs_done, config.runs)

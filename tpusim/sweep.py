@@ -143,7 +143,11 @@ def run_sweep(
     ``telemetry_path`` appends one structured span ledger for the whole
     sweep (tpusim.telemetry): a ``sweep_point`` span per point sharing one
     run_id, with the tpu backend's per-batch spans interleaved under the
-    same id — render with ``python -m tpusim report``.
+    same id — render with ``python -m tpusim report``. Inside a fleet
+    packed-grid worker the recorder adopts the supervisor's trace context
+    from ``TPUSIM_TRACE_CONTEXT`` (tpusim.tracing), so the sub-grid's spans
+    land in the fleet's span tree under the fleet run_id — which is why the
+    report dashboards partition their panels by ``(run_id, process)``.
 
     ``engine_cache`` shares compiled engines across same-shape grid points
     (tpusim.runner.make_engine): a sweep like selfish-hashrate varies only

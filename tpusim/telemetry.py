@@ -9,12 +9,22 @@ sink for both:
   * **Structured spans** — every batch, sweep point, checkpoint save/load,
     retry, pipelined-dispatch stall and per-batch convergence snapshot
     (the ``stats`` spans of tpusim.convergence) is one JSONL line
-    ``{"run_id", "span", "t_start", "dur_s", "attrs"}`` written by
+    ``{"run_id", "span", "t_start", "t_mono", "dur_s", "schema",
+    "process", "trace_id", ["parent_span",] "attrs"}`` written by
     :class:`TelemetryRecorder`. One ``run_id`` correlates every span of a
     run (and every point of a sweep), so a ledger can be grepped, joined
     across processes, or rendered into the ``tpusim report`` dashboard
     (tpusim.report). ``t_start`` is wall-clock epoch seconds (cross-process
-    correlation); ``dur_s`` comes from the monotonic clock.
+    correlation); ``dur_s`` comes from the monotonic clock; ``t_mono`` is
+    the raw monotonic reading at write time (span END), which is what the
+    distributed-tracing merger (tpusim.tracing) rebases per process so a
+    stepped wall clock can never reorder a timeline. ``schema`` is
+    :data:`SCHEMA_VERSION` (spans without one — pre-tracing ledgers — load
+    fine everywhere: every consumer treats the new fields as optional);
+    ``process`` identifies the emitting process; ``trace_id`` /
+    ``parent_span`` are the cross-process correlation pair propagated to
+    fleet workers via :data:`tpusim.tracing.TRACE_ENV` (``trace_id``
+    defaults to the recorder's own ``run_id`` at the trace root).
   * **Metrics registry** — :class:`MetricsRegistry` accumulates per-batch
     timing records and derives the phase/throughput report.
     ``tpusim.profiling.Profiler`` is a thin client of it, and
@@ -40,6 +50,7 @@ import contextlib
 import dataclasses
 import json
 import logging
+import os
 import time
 import uuid
 from pathlib import Path
@@ -47,7 +58,20 @@ from typing import Any, Iterator
 
 logger = logging.getLogger("tpusim")
 
+#: Span-row schema version. v2 added t_mono/schema/process/trace_id/
+#: parent_span (all additive); v1 ledgers carry none of them and every
+#: consumer tolerates their absence.
+SCHEMA_VERSION = 2
+
+#: This process's span identity: stable across every recorder the process
+#: creates (a fleet worker's handshake recorder and its runner recorder
+#: must land in ONE trace process), but unique beyond the pid — a year-long
+#: elastic fleet spawns enough workers that the kernel recycles pids, and
+#: two attempts sharing a bare pid would merge into one timeline process.
+PROCESS_ID = f"p{os.getpid()}-{uuid.uuid4().hex[:4]}"
+
 __all__ = [
+    "SCHEMA_VERSION",
     "TelemetryRecorder",
     "MetricsRegistry",
     "BatchRecord",
@@ -262,11 +286,28 @@ class TelemetryRecorder:
     volume) warns once and disables the recorder for the rest of the run —
     telemetry must never take a run down. ``chaos`` (tpusim.chaos) is the
     fault-injection seam that drills exactly that path.
+
+    **Trace context** (tpusim.tracing): a recorder created inside a fleet
+    worker finds ``TPUSIM_TRACE_CONTEXT`` in its environment and adopts the
+    supervisor's ``trace_id``/``run_id`` plus the ``parent_span`` naming the
+    spawn that created it — so every span this process ever emits lands in
+    the supervisor's span tree with no caller plumbing. At the trace root
+    (no context) ``trace_id`` defaults to the recorder's own ``run_id``.
+    An explicit ``run_id`` argument always wins over the context's.
     """
 
-    def __init__(self, path: str | Path, run_id: str | None = None, chaos=None):
+    def __init__(
+        self, path: str | Path, run_id: str | None = None, chaos=None,
+        trace=None,
+    ):
+        from .tracing import TraceContext  # lazy: tracing imports load_spans
+
+        ctx = trace if trace is not None else TraceContext.from_env()
         self.path = Path(path)
-        self.run_id = run_id or new_run_id()
+        self.run_id = run_id or (ctx.run_id if ctx else None) or new_run_id()
+        self.trace_id = ctx.trace_id if ctx else self.run_id
+        self.parent_span = ctx.parent_span if ctx else None
+        self.process = PROCESS_ID
         self.chaos = chaos
         self._fh = None
         self._dead = False
@@ -287,7 +328,16 @@ class TelemetryRecorder:
             "run_id": self.run_id,
             "span": span,
             "t_start": round(time.time() if t_start is None else t_start, 6),
+            # Monotonic reading at WRITE time == the span's END on a clock
+            # that cannot step; backdated t_start emissions included, since
+            # end - dur_s recovers the start (tpusim.tracing rebases on it).
+            "t_mono": round(time.monotonic(), 6),
             "dur_s": round(float(dur_s), 6),
+            "schema": SCHEMA_VERSION,
+            "process": self.process,
+            "trace_id": self.trace_id,
+            **({"parent_span": self.parent_span}
+               if self.parent_span is not None else {}),
             "attrs": _jsonable(attrs),
         }
         try:

@@ -176,6 +176,23 @@ def render_watch(spans: list[dict], source: str, now: float | None = None) -> st
                     lease += f" ({entry['runs_done']}/{entry.get('runs_total', '?')})"
                 parts.append(lease)
             out.append("  leases: " + ", ".join(parts))
+        # Per-worker occupancy, live: lease wall-clock per worker as a share
+        # of the fleet window so far (the supervisor ledger alone carries no
+        # worker spans — the category breakdown lives in `tpusim report
+        # STATE_DIR` and `tpusim trace timeline`, which merge them).
+        from .tracing import assemble, worker_utilization
+
+        trace = assemble(mine)
+        if trace is not None and trace.workers:
+            window = max(trace.t1 - trace.t0, 1e-9)
+            parts = []
+            for r in worker_utilization(trace)[-6:]:
+                share = min(r["alive_s"] / window, 1.0)
+                parts.append(
+                    f"{r['worker']} {r['point']} {r['alive_s']:.1f}s"
+                    f" ({100.0 * share:.0f}%, {r['end_reason']})"
+                )
+            out.append("  worker leases (share of fleet window): " + ", ".join(parts))
 
     # --- Convergence (the stats spans this dashboard exists for).
     out.append("")
