@@ -10,8 +10,23 @@ echo "== tpusim lint =="
 # Pure-AST static analysis (tpusim.lint): fails on any NEW finding — the
 # committed baseline grandfathers old ones. Runs first because it needs no
 # jax import and catches donated-buffer/host-sync/recompile mistakes in
-# seconds, before the expensive legs spin up.
+# seconds, before the expensive legs spin up. The per-module JAX rules
+# (JX001-JX009) AND the cross-module contract pass (JX010-JX013: telemetry
+# span/attr contracts, chaos seam registry, finalize leaf naming, CLI docs
+# drift) run in this one gate.
 python -m tpusim.cli lint --baseline .tpusim-lint-baseline.json
+# Registration floor: the contract passes must actually be REGISTERED *and*
+# ENABLED — a rule-table slip (a deleted registry row, a pyproject
+# enabled-rules regression) would otherwise rot this gate into a tautology
+# that greens while checking nothing. --list-rules annotates disabled rules,
+# so the floor counts rules that will actually RUN in the gate above.
+rule_count=$(python -m tpusim.cli lint --list-rules | grep -cv "(disabled)")
+if [ "$rule_count" -lt 13 ]; then
+  echo "lint gate degraded: only $rule_count rules enabled (need >= 13)" >&2
+  exit 1
+fi
+python -m tpusim.cli lint --list-rules | grep "^JX013" | grep -qv "(disabled)" \
+  || { echo "contract rules missing/disabled in --list-rules" >&2; exit 1; }
 
 echo "== native: build + ASan/UBSan/TSan smoke =="
 make -C native check
@@ -326,5 +341,38 @@ python -m tpusim trace --backend cpp --runs 2 --duration-ms 21600000 \
   --seed 11 --propagation-ms 30000 --quiet \
   --events-out "$tele_dir/native_events.jsonl"
 python -m tpusim trace diff "$tele_dir/jax_events.jsonl" "$tele_dir/native_events.jsonl"
+
+echo "== native sanitizer harness (ASan/UBSan under ctypes) =="
+# The same xoroshiro A/B + trace-diff recipe, but the native side runs the
+# ASan/UBSan-INSTRUMENTED library inside the real Python harness
+# (TPUSIM_SIMCORE_LIB override + preloaded sanitizer runtimes): the event
+# stream must stay byte-identical to the JAX engine's AND the sanitizers
+# must stay silent — `make check`'s standalone smoke cannot see bugs that
+# only the ctypes ABI surface (array lifetimes, int widths) provokes.
+# detect_leaks=0: CPython leaks by design at exit; halt_on_error=1 turns a
+# UBSan diagnostic into a red leg instead of a scrolled-past warning.
+asan_rt=$("${CXX:-g++}" -print-file-name=libasan.so 2>/dev/null || true)
+ubsan_rt=$("${CXX:-g++}" -print-file-name=libubsan.so 2>/dev/null || true)
+if [ -f "$asan_rt" ] && [ -f "$ubsan_rt" ] && make -C native sanitize; then
+  san_env="ASAN_OPTIONS=detect_leaks=0 UBSAN_OPTIONS=halt_on_error=1"
+  env LD_PRELOAD="$asan_rt $ubsan_rt" $san_env \
+    TPUSIM_SIMCORE_LIB=native/libsimcore_san.so \
+    python -m tpusim trace --backend cpp --runs 2 --duration-ms 21600000 \
+    --seed 11 --propagation-ms 30000 --quiet \
+    --events-out "$tele_dir/native_events_san.jsonl"
+  python -m tpusim trace diff \
+    "$tele_dir/jax_events.jsonl" "$tele_dir/native_events_san.jsonl"
+  # Threaded partitioning path under the sanitizers (the smoke binary runs
+  # it standalone; this drives it through run_simulation_cpp's ctypes ABI).
+  env LD_PRELOAD="$asan_rt $ubsan_rt" $san_env \
+    TPUSIM_SIMCORE_LIB=native/libsimcore_san.so \
+    python -m tpusim --backend cpp --runs 8 --threads 4 \
+    --duration-ms 86400000 --quiet > /dev/null
+else
+  # Loud skip, never silent: a missing sanitizer runtime must be visible in
+  # the CI log, not quietly green.
+  echo "SKIP: sanitizer harness leg NOT run (compiler lacks libasan/libubsan" \
+       "runtimes or the sanitize build failed)" >&2
+fi
 
 echo "== CI green =="

@@ -360,6 +360,58 @@ def test_report_spans_only_and_malformed_ledgers_render_no_data():
     assert "Sweep points" in text
 
 
+def test_dashboards_tolerate_partial_attrs_in_every_panel():
+    """The JX010 dogfood regression: a foreign/torn ledger whose spans carry
+    *partial* attrs — a run span with duration_ms but no block_interval_s
+    (KeyError on the pre-fix dashboard), batch spans with null attrs or null
+    watermark fields, stats spans missing runs_total — must render in both
+    dashboards instead of raising. Every attr read in the dashboards is
+    .get-based with a None-tolerant default; `tpusim lint` (JX010) pins the
+    discipline statically, this pins it at runtime."""
+    from tpusim.report import render_report
+    from tpusim.watch import render_watch
+
+    hostile = [
+        {"run_id": "x", "span": "batch", "dur_s": 1.0},
+        {"run_id": "x", "span": "batch", "attrs": None, "dur_s": 1.0},
+        # Keys PRESENT with null values: int(None)/float(None) is the crash
+        # class a .get(key, 0) default does not cover.
+        {"run_id": "x", "span": "batch", "dur_s": 2.0, "attrs": {
+            "mem_live_bytes": None, "mem_live_buffers": None,
+            "reorg_depth_max": 2, "stall_s": None, "vmem_est_bytes": None,
+            "runs": None, "retries": None, "stale_events": None,
+            "active_steps": None, "step_slots": None}},
+        {"run_id": "x", "span": "batch", "dur_s": None, "attrs": {"runs": 4}},
+        {"run_id": "x", "span": "stats", "attrs": {"duration_ms": 1000}},
+        {"run_id": "x", "span": "compile", "dur_s": 0.5},
+        # Null ROW fields: run_id null must not poison the run grouping
+        # (load_spans already drops "span": null rows at the source).
+        {"run_id": None, "span": "checkpoint_save"},
+        # The pre-fix crash: duration_ms present, block_interval_s absent.
+        {"run_id": "x", "span": "run", "attrs": {"duration_ms": 86400000}},
+    ]
+    text = render_report(hostile)
+    assert "Throughput" in text
+    frame = render_watch(hostile, "hostile.jsonl", now=0.0)
+    assert "run_id x" in frame
+
+
+def test_load_spans_drops_null_span_rows(tmp_path):
+    """A foreign line with "span": null is not a span: load_spans filters it
+    at the source so no consumer ever groups on a None span name."""
+    from tpusim.telemetry import load_spans
+
+    p = tmp_path / "l.jsonl"
+    p.write_text(
+        '{"span": null, "run_id": "a"}\n'
+        '{"span": 3, "run_id": "a"}\n'
+        '{"span": "batch", "run_id": "a"}\n'
+        '{"no_span": true}\n'
+    )
+    spans = load_spans(p)
+    assert [sp["span"] for sp in spans] == ["batch"]
+
+
 def test_report_renders_histogram_panels():
     from tpusim.report import render_report
 

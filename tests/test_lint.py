@@ -3,6 +3,13 @@ twin; suppression comments and the baseline round-trip behave; a fresh JX003
 use-after-donation introduced into the REAL engine.py source fails the gate
 (the CI-leg contract); and compile_count_guard pins one-compile-per-shape on
 Engine.run_batch (the runtime half of JX006).
+
+The contract pass (tpusim.lint.contracts, JX010-JX013) gets the same
+treatment on synthetic whole-project trees — seeded + clean twin per rule,
+interprocedural **spread resolution, baseline round-trip over the doc/drill
+finding shapes — plus the live CI-gate drill: a span-attr drift and an
+unregistered chaos seam written into the REAL tree on disk must each exit 1
+against the committed EMPTY baseline.
 """
 
 from __future__ import annotations
@@ -956,3 +963,502 @@ def test_run_batch_compiles_once_per_shape():
     engine.run_batch(keys[2], pipelined=True)
     with compile_count_guard(exact=0):
         engine.run_batch(keys[3], pipelined=True)
+
+
+# ---------------------------------------------------------------------------
+# Contract pass (tpusim.lint.contracts): JX010-JX013 on synthetic projects.
+
+from tpusim.lint import CONTRACT_RULES, lint_contracts  # noqa: E402
+
+
+def _contract_cfg(**over):
+    base = dict(
+        include=("*.py",),
+        exclude=(),
+        telemetry_modules=("producer.py", "consumer.py"),
+        span_writer="producer.py:Recorder.emit",
+        span_schema_required=("run_id", "span", "attrs"),
+        context_methods=("set_context",),
+        drill_globs=("drills/*.json",),
+        doc_files=("README.md",),
+        engine_leaf_modules=("eng.py",),
+        leaf_dict_names=("sums", "out"),
+        leaf_consumer_modules=("orc.py",),
+        leaf_read_names=("raw",),
+        leaf_strip_prefixes=("tele_",),
+        leaf_merge_suffixes=("_sum", "_max", "_per_run"),
+        leaf_scalar_allowlist=("runs",),
+        cli_modules=("cli_mod.py",),
+        flag_ignore=(),
+    )
+    base.update(over)
+    return LintConfig(**base)
+
+
+_README_OK = """# proj
+
+<!-- tpusim-lint: span-schema -->
+- Span schema: `{"run_id", "span", "attrs"}` per line.
+
+<!-- tpusim-lint: chaos-seam-table -->
+| point | fired from |
+|---|---|
+| `engine.dispatch` | the runner |
+"""
+
+_PRODUCER_OK = """
+class Recorder:
+    def emit(self, span, **attrs):
+        row = {"run_id": self.run_id, "span": span, "attrs": attrs}
+        self.fh.write(row)
+
+
+def run(rec, chaos):
+    chaos.fire("engine.dispatch", batch=0)
+    rec.emit("batch", runs=4, stall_s=0.25)
+"""
+
+
+def _write_contract_proj(tmp_path, producer=_PRODUCER_OK, consumer="",
+                         readme=_README_OK, drills=(), **cfg_over):
+    (tmp_path / "producer.py").write_text(textwrap.dedent(producer))
+    (tmp_path / "consumer.py").write_text(textwrap.dedent(consumer))
+    (tmp_path / "README.md").write_text(readme)
+    (tmp_path / "drills").mkdir(exist_ok=True)
+    for name, text in drills:
+        (tmp_path / "drills" / name).write_text(text)
+    return _contract_cfg(**cfg_over)
+
+
+def contract_rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def test_jx010_consumed_key_never_emitted(tmp_path):
+    bad = """
+        def render(spans):
+            for sp in spans:
+                a = sp.get("attrs") or {}
+                a.get("runs")          # emitted: clean
+                a.get("ghost_key")     # never emitted: JX010
+    """
+    cfg = _write_contract_proj(tmp_path, consumer=bad)
+    findings = lint_contracts(tmp_path, cfg, rules=["JX010"])
+    msgs = [f.message for f in findings]
+    assert any("ghost_key" in m for m in msgs)
+    assert not any("`runs`" in m for m in msgs)
+    # Clean twin: emitting the key clears the finding.
+    ok = _PRODUCER_OK + "\n\ndef more(rec):\n    rec.emit(\"batch\", ghost_key=1)\n"
+    cfg = _write_contract_proj(tmp_path, producer=ok, consumer=bad)
+    assert lint_contracts(tmp_path, cfg, rules=["JX010"]) == []
+
+
+def test_jx010_spread_resolution_through_dicts_and_helpers(tmp_path):
+    """**attrs spreads resolve through dict()/update()/subscript stores and
+    attr-returning helper functions — the runner's real emit shape."""
+    producer = """
+        class Recorder:
+            def emit(self, span, **attrs):
+                row = {"run_id": 1, "span": span, "attrs": attrs}
+
+        def helper_attrs():
+            extra = {}
+            extra["mem_bytes"] = 7
+            return extra
+
+        def run(rec):
+            attrs = dict(runs=4)
+            attrs.update(helper_attrs())
+            attrs.update(stall_s=0.1)
+            attrs["engine"] = "Engine"
+            rec.emit("batch", **attrs)
+    """
+    consumer = """
+        def render(spans):
+            for sp in spans:
+                a = sp.get("attrs") or {}
+                a.get("runs"); a.get("mem_bytes"); a.get("stall_s"); a.get("engine")
+    """
+    cfg = _write_contract_proj(tmp_path, producer=producer, consumer=consumer)
+    # The seam table names engine.dispatch which this producer never fires;
+    # scope the run to JX010 only.
+    assert lint_contracts(tmp_path, cfg, rules=["JX010"]) == []
+
+
+def test_jx010_span_name_and_prefix_consumption(tmp_path):
+    consumer = """
+        def render(spans):
+            batches = [sp for sp in spans if sp["span"] == "batch"]    # emitted
+            ghosts = [sp for sp in spans if sp.get("span") == "ghost"] # JX010
+            pref = [sp for sp in spans
+                    if str(sp.get("span", "")).startswith("fleet_")]   # JX010
+            return batches, ghosts, pref
+    """
+    cfg = _write_contract_proj(tmp_path, consumer=consumer)
+    msgs = [f.message for f in lint_contracts(tmp_path, cfg, rules=["JX010"])]
+    assert any("`ghost`" in m for m in msgs)
+    assert any("`fleet_`" in m for m in msgs)
+    assert not any("`batch`" in m for m in msgs)
+
+
+def test_jx010_raw_attr_subscript_and_get_twin(tmp_path):
+    bad = """
+        def render(spans):
+            for sp in spans:
+                x = (sp.get("attrs") or {})["runs"]    # raw subscript: JX010
+            return x
+    """
+    cfg = _write_contract_proj(tmp_path, consumer=bad)
+    findings = lint_contracts(tmp_path, cfg, rules=["JX010"])
+    assert any("raw" in f.message and "subscript" in f.message for f in findings)
+    ok = bad.replace('["runs"]', '.get("runs")')
+    cfg = _write_contract_proj(tmp_path, consumer=ok)
+    assert lint_contracts(tmp_path, cfg, rules=["JX010"]) == []
+
+
+def test_jx010_schema_required_field_omission(tmp_path):
+    producer = """
+        class Recorder:
+            def emit(self, span, **attrs):
+                row = {"run_id": 1, "span": span}   # "attrs" omitted
+    """
+    readme = _README_OK.replace('"attrs"}', '"attrs"}')  # doc still lists it
+    cfg = _write_contract_proj(tmp_path, producer=producer, readme=readme)
+    findings = lint_contracts(tmp_path, cfg, rules=["JX010"])
+    assert any("omits required schema" in f.message for f in findings)
+    # The doc cross-check also flags the field the writer no longer produces.
+    assert any("never produces" in f.message for f in findings)
+
+
+def test_jx010_schema_doc_marker_missing_is_loud(tmp_path):
+    readme = _README_OK.replace("tpusim-lint: span-schema", "no marker here")
+    cfg = _write_contract_proj(tmp_path, readme=readme)
+    findings = lint_contracts(tmp_path, cfg, rules=["JX010"])
+    assert any("span-schema` marker" in f.message for f in findings)
+
+
+def test_jx011_drill_naming_unfired_seam(tmp_path):
+    drill = '{"faults": [{"point": "ghost.seam", "kind": "transient"}]}'
+    cfg = _write_contract_proj(tmp_path, drills=[("bad.json", drill)])
+    findings = lint_contracts(tmp_path, cfg, rules=["JX011"])
+    assert any(
+        f.rule == "JX011" and "ghost.seam" in f.message
+        and f.path == "drills/bad.json" for f in findings
+    )
+    ok = '{"faults": [{"point": "engine.dispatch", "kind": "transient"}]}'
+    cfg = _write_contract_proj(tmp_path, drills=[("bad.json", ok)])
+    assert lint_contracts(tmp_path, cfg, rules=["JX011"]) == []
+
+
+def test_jx011_table_vs_code_both_directions(tmp_path):
+    # Documented seam nothing fires.
+    readme = _README_OK.replace("`engine.dispatch`", "`stale.seam`")
+    cfg = _write_contract_proj(tmp_path, readme=readme)
+    findings = lint_contracts(tmp_path, cfg, rules=["JX011"])
+    assert any("`stale.seam`" in f.message and f.path == "README.md"
+               for f in findings)
+    # Fired seam the table omits.
+    assert any("`engine.dispatch`" in f.message and f.path == "producer.py"
+               for f in findings)
+    # Missing marker is itself loud.
+    cfg = _write_contract_proj(
+        tmp_path, readme="# no marker\n", drills=()
+    )
+    findings = lint_contracts(tmp_path, cfg, rules=["JX011"])
+    assert any("chaos-seam-table` marker" in f.message for f in findings)
+
+
+_ENG_OK = """
+def combine_sums(a, b):
+    def merge(k):
+        if k.startswith("flight_") or k.endswith("_per_run"):
+            return 1
+        if k.endswith("_max"):
+            return 2
+        return 3
+    return {k: merge(k) for k in a}
+
+
+def finalize_fn(state):
+    return {"blocks_sum": 1, "share_per_run": 2}
+
+
+def run_batch(n):
+    sums = {}
+    sums["tele_depth_max"] = 3
+    sums["runs"] = n
+    return sums
+"""
+
+_ORC_OK = """
+def drive(raw):
+    raw["tele_depth_max"]
+    for k in list(raw):
+        if k.startswith("tele_"):
+            raw.pop(k)
+"""
+
+
+def test_jx012_naming_contract_and_consumed_leaves(tmp_path):
+    (tmp_path / "eng.py").write_text(_ENG_OK)
+    (tmp_path / "orc.py").write_text(_ORC_OK)
+    cfg = _write_contract_proj(tmp_path)
+    assert lint_contracts(tmp_path, cfg, rules=["JX012"]) == []
+    # A leaf outside every merge class fires.
+    (tmp_path / "eng.py").write_text(
+        _ENG_OK + "\n\ndef extra(sums):\n    sums[\"deepest_reorg\"] = 1\n"
+    )
+    findings = lint_contracts(tmp_path, cfg, rules=["JX012"])
+    assert any("deepest_reorg" in f.message and "merge class" in f.message
+               for f in findings)
+    # A consumed leaf nothing produces fires.
+    (tmp_path / "eng.py").write_text(_ENG_OK)
+    (tmp_path / "orc.py").write_text(
+        _ORC_OK + "\n\ndef dead(raw):\n    raw[\"tele_gone_sum\"]\n"
+    )
+    findings = lint_contracts(tmp_path, cfg, rules=["JX012"])
+    assert any("tele_gone_sum" in f.message for f in findings)
+
+
+def test_jx012_merge_rule_and_strip_list_drift(tmp_path):
+    # combine_sums losing a merge literal fires.
+    eng = _ENG_OK.replace('k.endswith("_max")', 'k.endswith("_mx")')
+    (tmp_path / "eng.py").write_text(eng)
+    (tmp_path / "orc.py").write_text(_ORC_OK)
+    cfg = _write_contract_proj(tmp_path)
+    findings = lint_contracts(tmp_path, cfg, rules=["JX012"])
+    assert any("_max" in f.message and "combine_sums" in f.message
+               for f in findings)
+    # The consumer module losing its strip literal fires.
+    (tmp_path / "eng.py").write_text(_ENG_OK)
+    (tmp_path / "orc.py").write_text(
+        _ORC_OK.replace('k.startswith("tele_")', 'k.startswith("t_")')
+    )
+    findings = lint_contracts(tmp_path, cfg, rules=["JX012"])
+    assert any("strips" in f.message and "tele_" in f.message for f in findings)
+
+
+def test_jx013_doc_flag_drift_and_ignore(tmp_path):
+    (tmp_path / "cli_mod.py").write_text(
+        "import argparse\np = argparse.ArgumentParser()\n"
+        "p.add_argument(\"--runs\", type=int)\n"
+    )
+    readme = _README_OK + "\nRun with `--runs 4 --ghost-flag`.\n"
+    cfg = _write_contract_proj(tmp_path, readme=readme)
+    findings = lint_contracts(tmp_path, cfg, rules=["JX013"])
+    assert any("--ghost-flag" in f.message for f in findings)
+    assert not any("--runs" in f.message for f in findings)
+    cfg = _write_contract_proj(
+        tmp_path, readme=readme, flag_ignore=("--ghost-flag",)
+    )
+    assert lint_contracts(tmp_path, cfg, rules=["JX013"]) == []
+
+
+def test_contract_findings_baseline_round_trip_and_line_shift(tmp_path):
+    """Contract findings (including doc/drill ones) ride the same
+    line-number-free fingerprints as the per-module rules."""
+    drill = (
+        '{"faults": [\n'
+        '  {"point": "ghost.seam", "kind": "transient"}\n'
+        ']}'
+    )
+    bad = """
+        def render(spans):
+            for sp in spans:
+                (sp.get("attrs") or {}).get("ghost_key")
+    """
+    cfg = _write_contract_proj(tmp_path, consumer=bad, drills=[("d.json", drill)])
+    findings = lint_contracts(tmp_path, cfg)
+    assert {"JX010", "JX011"} <= contract_rules_of(findings)
+    path = tmp_path / "bl.json"
+    Baseline.write(path, findings)
+    # Shift every finding down WITHOUT changing the offending lines' text:
+    # fingerprints key on (rule, path, normalized line, occurrence).
+    (tmp_path / "consumer.py").write_text(
+        "# pad\n# pad\n" + textwrap.dedent(bad)
+    )
+    (tmp_path / "drills" / "d.json").write_text("\n\n" + drill)
+    shifted = lint_contracts(tmp_path, cfg)
+    new, old = Baseline.load(path).split(shifted)
+    assert new == [] and len(old) == len(shifted) > 0
+
+
+def test_contract_suppression_comment_in_python(tmp_path):
+    bad = """
+        def render(spans):
+            for sp in spans:
+                # tpusim-lint: disable=JX010 -- probing a foreign emitter's key
+                (sp.get("attrs") or {}).get("ghost_key")
+    """
+    cfg = _write_contract_proj(tmp_path, consumer=bad)
+    assert lint_contracts(tmp_path, cfg, rules=["JX010"]) == []
+
+
+def test_contract_rules_listed_and_registered(capsys):
+    """The CI floor's unit twin: >= 13 rules listed AND enabled for this
+    repo's config (the floor greps out "(disabled)" annotations, so a
+    pyproject enabled-rules regression shows up here, not just a registry
+    slip)."""
+    assert set(CONTRACT_RULES) == {"JX010", "JX011", "JX012", "JX013"}
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    enabled_lines = [
+        ln for ln in out.splitlines() if ln.strip() and "(disabled)" not in ln
+    ]
+    assert len(enabled_lines) >= 13
+    for rid in CONTRACT_RULES:
+        assert any(ln.startswith(rid) for ln in enabled_lines)
+
+
+def test_list_rules_annotates_disabled(tmp_path, capsys, monkeypatch):
+    """A pyproject that disables a contract rule must show it as (disabled)
+    — the CI rule-count floor counts only enabled rules."""
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "pyproject.toml").write_text(
+        "[tool.tpusim-lint]\nenabled-rules = [\"JX001\"]\n"
+    )
+    monkeypatch.chdir(proj)
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "JX013  (disabled)" in out
+    assert not out.splitlines()[0].startswith("JX001  (disabled)")
+
+
+def test_jx010_no_cross_function_name_bleed(tmp_path):
+    """Scopes are per-function: an unrelated function's same-named local
+    must neither be classified as span attrs (false positive) nor inflate
+    the emitted-key set through its own dict stores (false negative)."""
+    consumer = """
+        def f(spans):
+            for sp in spans:
+                a = sp.get("attrs") or {}
+                a.get("runs")
+
+        def g(cfg):
+            a = dict(cfg)
+            a["paths"]          # NOT span attrs: no JX010 here
+            return a
+    """
+    cfg = _write_contract_proj(tmp_path, consumer=consumer)
+    assert lint_contracts(tmp_path, cfg, rules=["JX010"]) == []
+    # False-negative direction: a producer module whose unrelated function
+    # stores "ghost" into its own local `attrs` must NOT count as emitting
+    # it — the consumer read stays flagged.
+    producer = _PRODUCER_OK + """
+
+def unrelated():
+    attrs = {}
+    attrs["ghost"] = 1
+    return attrs["ghost"]
+"""
+    consumer = """
+        def render(spans):
+            for sp in spans:
+                (sp.get("attrs") or {}).get("ghost")
+    """
+    cfg = _write_contract_proj(tmp_path, producer=producer, consumer=consumer)
+    findings = lint_contracts(tmp_path, cfg, rules=["JX010"])
+    assert any("`ghost`" in f.message for f in findings)
+
+
+def test_jx011_malformed_drill_shapes_are_findings_not_crashes(tmp_path):
+    """Valid JSON of the wrong shape (top-level list, string fault entry)
+    must yield the broken-drill finding, not an analyzer traceback."""
+    for payload in (
+        '[{"point": "engine.dispatch"}]',
+        '{"faults": "engine.dispatch"}',
+        '{"faults": ["engine.dispatch"]}',
+        "not json at all {",
+    ):
+        cfg = _write_contract_proj(tmp_path, drills=[("bad.json", payload)])
+        findings = lint_contracts(tmp_path, cfg, rules=["JX011"])
+        assert any(
+            f.path == "drills/bad.json" and "certifies nothing" in f.message
+            for f in findings
+        ), payload
+
+
+def test_contract_rules_match_case_insensitively(tmp_path):
+    """Lowercase ids in an enabled-rules config must still run the contract
+    pass (lint_source upper-cases; the contract trigger must agree) — else
+    the gate silently degrades while --list-rules reports all-enabled."""
+    bad = """
+        def render(spans):
+            for sp in spans:
+                (sp.get("attrs") or {}).get("ghost_key")
+    """
+    cfg = _write_contract_proj(tmp_path, consumer=bad,
+                               enabled_rules=("jx010",))
+    findings = lint_contracts(tmp_path, cfg)
+    assert any("ghost_key" in f.message for f in findings)
+
+
+def test_jx010_two_defects_at_one_node_both_survive(tmp_path):
+    """A raw subscript of a never-emitted key is TWO defects at one
+    position; the dedup key includes the message so neither is dropped."""
+    bad = """
+        def render(spans):
+            for sp in spans:
+                (sp.get("attrs") or {})["ghost_key"]
+    """
+    cfg = _write_contract_proj(tmp_path, consumer=bad)
+    findings = lint_contracts(tmp_path, cfg, rules=["JX010"])
+    msgs = [f.message for f in findings]
+    assert any("ghost_key" in m and "no emit site" in m for m in msgs)
+    assert any("raw" in m and "subscript" in m for m in msgs)
+
+
+def test_live_injected_drift_fails_the_gate(capsys):
+    """The CI-leg contract end-to-end on the REAL tree: a synthetic span-attr
+    drift written into report.py on disk and an unregistered chaos seam
+    written into probe.py must each fail the lint gate (exit 1) against the
+    committed EMPTY baseline, and the reverted tree must pass again."""
+    baseline = str(REPO / ".tpusim-lint-baseline.json")
+    report = REPO / "tpusim" / "report.py"
+    probe = REPO / "tpusim" / "probe.py"
+    orig_report, orig_probe = report.read_text(), probe.read_text()
+    try:
+        report.write_text(orig_report + textwrap.dedent("""
+
+            def _drifted_consumer(sp):
+                return (sp.get("attrs") or {}).get("attr_key_nobody_emits")
+        """))
+        assert lint_main(["--baseline", baseline, "--quiet"]) == 1
+        out = capsys.readouterr().out
+        assert "attr_key_nobody_emits" in out and "JX010" in out
+    finally:
+        report.write_text(orig_report)
+    try:
+        probe.write_text(orig_probe + textwrap.dedent("""
+
+            def _unregistered_seam(chaos):
+                chaos.fire("drill.seam_nobody_documents")
+        """))
+        assert lint_main(["--baseline", baseline, "--quiet"]) == 1
+        out = capsys.readouterr().out
+        assert "seam_nobody_documents" in out and "JX011" in out
+    finally:
+        probe.write_text(orig_probe)
+    assert lint_main(["--baseline", baseline, "--quiet"]) == 0
+
+
+def test_cli_github_format(tmp_path, capsys, monkeypatch):
+    """--format github emits workflow-annotation lines the Actions runner
+    renders inline on the diff."""
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "pyproject.toml").write_text(
+        "[tool.tpusim-lint]\ninclude = [\"*.py\"]\nexclude = []\n"
+        "enabled-rules = [\"JX001\"]\n"
+    )
+    (proj / "bad.py").write_text(
+        "import jax\n\n@jax.jit\ndef f(x):\n    if x > 0:\n        return x\n"
+        "    return -x\n"
+    )
+    monkeypatch.chdir(proj)
+    rc = lint_main(["--format", "github", "--quiet"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert out.startswith("::error file=bad.py,line=")
+    assert "title=JX001" in out

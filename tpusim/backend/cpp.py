@@ -11,6 +11,7 @@ the ABI is 5 flat arrays, ctypes is the right amount of machinery).
 from __future__ import annotations
 
 import ctypes
+import os
 import subprocess
 from pathlib import Path
 
@@ -31,6 +32,17 @@ class NativeBuildError(RuntimeError):
 
 
 def _ensure_built() -> Path:
+    # TPUSIM_SIMCORE_LIB points the bindings at an alternative prebuilt
+    # library — the ci.sh sanitizer leg loads libsimcore_san.so (ASan/UBSan
+    # instrumented, LD_PRELOADed runtime) through the exact same Python
+    # harness as the production library, so the xoroshiro A/B and trace-diff
+    # contracts run under the sanitizers instead of only the C++ smoke.
+    override = os.environ.get("TPUSIM_SIMCORE_LIB")
+    if override:
+        p = Path(override)
+        if not p.exists():
+            raise NativeBuildError(f"TPUSIM_SIMCORE_LIB={override} does not exist")
+        return p
     if not _SRC_PATH.exists():
         raise NativeBuildError(f"native source missing at {_SRC_PATH}")
     # Always invoke make: it is a no-op when up to date and, unlike a

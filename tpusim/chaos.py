@@ -63,6 +63,11 @@ Injection points wired through the repo (the plan's ``point`` vocabulary):
                         expired, the deterministic-time expiry drill)
   ====================  =====================================================
 
+This table's checkable mirror is the README "Fault injection" seam table:
+`tpusim lint` (JX011, tpusim.lint.contracts) cross-checks the README rows
+and every committed ``drills/*.json`` plan against the live ``fire()`` call
+sites, so adding/renaming a seam here without updating both fails CI.
+
 This module imports no jax (the probe must stay importable before any
 backend touch) and nothing from the rest of the package.
 """

@@ -27,6 +27,19 @@ Rules (see :mod:`tpusim.lint.rules` for the precise semantics):
   JX008  unused-reachability: module-level defs/imports nothing references
          (scripts only by default), so shims cannot accrete dead helpers
 
+A second, *cross-module* pass (tpusim.lint.contracts) pins the jax-free
+orchestration layer's stringly-typed protocols — the failure surface the
+telemetry/chaos/fleet/packed PRs grew that no per-module rule can see:
+
+  JX010  telemetry contract: span names / attr keys consumed by the
+         dashboards but emitted nowhere; schema-v2 required-row-field
+         omissions; raw ``["key"]`` attr subscripts a torn ledger crashes
+  JX011  chaos seams: code ``fire()`` sites vs the README seam table vs the
+         committed ``drills/*.json`` plans — all three must agree
+  JX012  finalize leaf naming: every engine output leaf must self-describe
+         its combine_sums merge and its runner strip/checkpoint fate
+  JX013  CLI docs drift: a README-documented ``--flag`` no parser declares
+
 Suppression: append ``# tpusim-lint: disable=JX002 -- reason`` to the
 offending line (or put the comment alone on the line above). A committed
 baseline file grandfathers pre-existing findings; the CI gate fails only on
@@ -38,15 +51,18 @@ from __future__ import annotations
 from .analysis import ModuleAnalysis
 from .baseline import Baseline
 from .config import LintConfig, load_config
+from .contracts import CONTRACT_RULES, lint_contracts
 from .findings import Finding
 from .rules import ALL_RULES, lint_paths, lint_source
 
 __all__ = [
     "ALL_RULES",
+    "CONTRACT_RULES",
     "Baseline",
     "Finding",
     "LintConfig",
     "ModuleAnalysis",
+    "lint_contracts",
     "lint_paths",
     "lint_source",
     "load_config",

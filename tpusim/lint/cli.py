@@ -19,6 +19,7 @@ from pathlib import Path
 
 from .baseline import Baseline
 from .config import load_config
+from .contracts import CONTRACT_RULES, lint_contracts
 from .rules import ALL_RULES, lint_paths
 
 
@@ -54,8 +55,8 @@ def build_parser() -> argparse.ArgumentParser:
         help="comma-separated rule ids to run (default: enabled-rules config)",
     )
     p.add_argument(
-        "--format", choices=("text", "json"), default="text",
-        help="finding output format",
+        "--format", choices=("text", "json", "github"), default="text",
+        help="finding output format (github = workflow-annotation lines)",
     )
     p.add_argument("--list-rules", action="store_true", help="print the rule table")
     p.add_argument("--quiet", action="store_true", help="suppress the summary line")
@@ -107,21 +108,48 @@ def _collect_files(args, root: Path, config) -> list[Path]:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
-    if args.list_rules:
-        for rule_id, (_, desc) in sorted(ALL_RULES.items()):
-            print(f"{rule_id}  {desc}")
-        return 0
     root = _repo_root()
     config = load_config(root / "pyproject.toml")
+    if args.list_rules:
+        # Annotated with the PROJECT's enabled-rules state: the CI
+        # rule-count floor greps this output, and a pyproject enabled-rules
+        # regression must show up here as "(disabled)" — a registry-only
+        # listing would stay green while the gate silently stopped running
+        # the rule.
+        table = {
+            **{rid: desc for rid, (_, desc) in ALL_RULES.items()},
+            **{rid: desc for rid, (_, desc) in CONTRACT_RULES.items()},
+        }
+        enabled = {r.upper() for r in config.enabled_rules}
+        for rule_id in sorted(table):
+            mark = "" if rule_id in enabled else "  (disabled)"
+            print(f"{rule_id}{mark}  {table[rule_id]}")
+        return 0
     rules = None
     if args.rules:
         rules = [r.strip().upper() for r in args.rules.split(",") if r.strip()]
-        unknown = [r for r in rules if r not in ALL_RULES]
+        unknown = [
+            r for r in rules if r not in ALL_RULES and r not in CONTRACT_RULES
+        ]
         if unknown:
             print(f"error: unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
             return 2
     files = _collect_files(args, root, config)
     findings = lint_paths(files, root, config=config, rules=rules)
+    # The cross-module contract pass (JX010-JX013) reads its own configured
+    # module/doc/drill set from the project root — a partial file list cannot
+    # see a cross-module contract, so it runs on the full walk (no explicit
+    # paths) or when a contract rule is requested by id.
+    # Upper-cased like lint_source's rule matching: lowercase ids in a
+    # pyproject enabled-rules list must not silently disable the contract
+    # pass while --list-rules reports it enabled.
+    enabled = [
+        r.upper() for r in (rules if rules is not None else config.enabled_rules)
+    ]
+    wants_contracts = any(r in CONTRACT_RULES for r in enabled)
+    if wants_contracts and (not args.paths or rules is not None):
+        findings.extend(lint_contracts(root, config=config, rules=enabled))
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
 
     if args.write_baseline:
         Baseline.write(args.write_baseline, findings)
@@ -152,6 +180,16 @@ def main(argv: list[str] | None = None) -> int:
                 indent=2,
             )
         )
+    elif args.format == "github":
+        # GitHub Actions workflow-annotation lines: the runner surfaces each
+        # finding inline on the PR diff. Newlines are %0A-escaped per the
+        # workflow-command spec.
+        for f in findings:
+            msg = f.message.replace("%", "%25").replace("\n", "%0A")
+            print(
+                f"::error file={f.path},line={f.line},"
+                f"col={f.col + 1},title={f.rule}::{msg}"
+            )
     else:
         for f in findings:
             print(f.render())

@@ -72,7 +72,77 @@ _DEFAULT_MEASUREMENT = (
     "tpusim/perf.py",
     "scripts/*.py",
 )
-_ALL_RULE_IDS = tuple(f"JX{n:03d}" for n in range(1, 10))
+# -- Contract-pass knowledge (tpusim.lint.contracts, JX010-JX013). ----------
+#: The telemetry protocol's producer AND consumer modules: emit sites, the
+#: dashboards' attrs/span reads, and the attr-returning helpers the
+#: ``**spread`` resolver follows (engine/pallas memory_attrs live here too).
+_DEFAULT_TELEMETRY_MODULES = (
+    "tpusim/telemetry.py",
+    "tpusim/runner.py",
+    "tpusim/sweep.py",
+    "tpusim/packed.py",
+    "tpusim/fleet.py",
+    "tpusim/chaos.py",
+    "tpusim/flight_export.py",
+    "tpusim/report.py",
+    "tpusim/watch.py",
+    "tpusim/tracing.py",
+    "tpusim/convergence.py",
+    "tpusim/engine.py",
+    "tpusim/pallas_engine.py",
+)
+#: Where the span row literal lives (the schema-v2 source of truth).
+_DEFAULT_SPAN_WRITER = "tpusim/telemetry.py:TelemetryRecorder.emit"
+#: Row fields every v2 span line must carry (parent_span is conditional).
+_DEFAULT_SCHEMA_REQUIRED = (
+    "run_id", "span", "t_start", "t_mono", "dur_s", "schema", "process",
+    "trace_id", "attrs",
+)
+#: Methods whose keyword names flow into later spans (CompileLedger context).
+_DEFAULT_CONTEXT_METHODS = ("set_context",)
+#: Committed chaos drill plans (JX011's drilled-seam source).
+_DEFAULT_DRILL_GLOBS = ("drills/*.json",)
+#: Docs the contract pass cross-checks: the chaos seam table and span-schema
+#: markers, and the JX013 flag scan.
+_DEFAULT_DOC_FILES = ("README.md", "drills/README.md")
+#: Engine modules whose output-dict stores define the finalize leaf set.
+_DEFAULT_ENGINE_LEAF_MODULES = ("tpusim/engine.py", "tpusim/pallas_engine.py")
+#: Dict names the engines build run_batch outputs in.
+_DEFAULT_LEAF_DICT_NAMES = ("sums", "out", "dev_sums", "loop_out_specs")
+#: Orchestration modules that read finalize leaves by name (runner first:
+#: it is also where the strip-prefix literals are verified).
+_DEFAULT_LEAF_CONSUMERS = ("tpusim/runner.py", "tpusim/packed.py")
+#: Telemetry leaf prefixes the runner strips from the stat/checkpoint path.
+_DEFAULT_LEAF_STRIP_PREFIXES = ("tele_", "stats_", "flight_")
+#: Merge-describing suffixes combine_sums keys on (additive/max/concat).
+_DEFAULT_LEAF_MERGE_SUFFIXES = ("_sum", "_max", "_per_run")
+#: The prefix/suffix literals combine_sums must TEST (its non-additive merge
+#: branches); "_sum" is the additive default and needs no test.
+_DEFAULT_COMBINE_MERGE_LITERALS = ("flight_", "_per_run", "_max")
+#: Scalar leaves exempt from the naming contract (additive by construction).
+_DEFAULT_LEAF_SCALARS = ("runs", "n_chunks", "unfinished")
+#: Modules whose argparse add_argument calls declare the CLI flag universe.
+_DEFAULT_CLI_MODULES = (
+    "tpusim/cli.py",
+    "tpusim/lint/cli.py",
+    "tpusim/report.py",
+    "tpusim/watch.py",
+    "tpusim/sweep.py",
+    "tpusim/fleet.py",
+    "tpusim/perf.py",
+    "tpusim/flight_export.py",
+    "tpusim/tracing.py",
+    "tpusim/analysis/plots.py",
+    "bench.py",
+    "scripts/*.py",
+)
+#: Documented flags that belong to external tools, not this CLI.
+_DEFAULT_FLAG_IGNORE = ()
+#: Dict receivers in the leaf-consumer modules whose string-keyed reads ARE
+#: engine finalize-leaf consumption (the JX012 cross-check set); generic
+#: summary/config dicts that merely reuse a leaf-ish suffix stay out.
+_DEFAULT_LEAF_READ_NAMES = ("raw", "tele_b", "batch_sums")
+_ALL_RULE_IDS = tuple(f"JX{n:03d}" for n in range(1, 14))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -86,6 +156,23 @@ class LintConfig:
     device_call_patterns: tuple[str, ...] = _DEFAULT_DEVICE_CALLS
     prng_consumers: tuple[str, ...] = _DEFAULT_PRNG_CONSUMERS
     measurement_modules: tuple[str, ...] = _DEFAULT_MEASUREMENT
+    # Contract-pass knowledge (JX010-JX013; tpusim.lint.contracts).
+    telemetry_modules: tuple[str, ...] = _DEFAULT_TELEMETRY_MODULES
+    span_writer: str = _DEFAULT_SPAN_WRITER
+    span_schema_required: tuple[str, ...] = _DEFAULT_SCHEMA_REQUIRED
+    context_methods: tuple[str, ...] = _DEFAULT_CONTEXT_METHODS
+    drill_globs: tuple[str, ...] = _DEFAULT_DRILL_GLOBS
+    doc_files: tuple[str, ...] = _DEFAULT_DOC_FILES
+    engine_leaf_modules: tuple[str, ...] = _DEFAULT_ENGINE_LEAF_MODULES
+    leaf_dict_names: tuple[str, ...] = _DEFAULT_LEAF_DICT_NAMES
+    leaf_consumer_modules: tuple[str, ...] = _DEFAULT_LEAF_CONSUMERS
+    leaf_read_names: tuple[str, ...] = _DEFAULT_LEAF_READ_NAMES
+    leaf_strip_prefixes: tuple[str, ...] = _DEFAULT_LEAF_STRIP_PREFIXES
+    leaf_merge_suffixes: tuple[str, ...] = _DEFAULT_LEAF_MERGE_SUFFIXES
+    combine_merge_literals: tuple[str, ...] = _DEFAULT_COMBINE_MERGE_LITERALS
+    leaf_scalar_allowlist: tuple[str, ...] = _DEFAULT_LEAF_SCALARS
+    cli_modules: tuple[str, ...] = _DEFAULT_CLI_MODULES
+    flag_ignore: tuple[str, ...] = _DEFAULT_FLAG_IGNORE
 
     def matches(self, rel_path: str, globs: tuple[str, ...]) -> bool:
         rel = rel_path.replace("\\", "/")
@@ -119,7 +206,24 @@ def load_config(pyproject: Path | None = None) -> LintConfig:
         ("device_call_patterns", "device-call-patterns"),
         ("prng_consumers", "prng-consumers"),
         ("measurement_modules", "measurement-modules"),
+        ("telemetry_modules", "telemetry-modules"),
+        ("span_schema_required", "span-schema-required"),
+        ("context_methods", "context-methods"),
+        ("drill_globs", "drill-globs"),
+        ("doc_files", "doc-files"),
+        ("engine_leaf_modules", "engine-leaf-modules"),
+        ("leaf_dict_names", "leaf-dict-names"),
+        ("leaf_consumer_modules", "leaf-consumer-modules"),
+        ("leaf_read_names", "leaf-read-names"),
+        ("leaf_strip_prefixes", "leaf-strip-prefixes"),
+        ("leaf_merge_suffixes", "leaf-merge-suffixes"),
+        ("combine_merge_literals", "combine-merge-literals"),
+        ("leaf_scalar_allowlist", "leaf-scalar-allowlist"),
+        ("cli_modules", "cli-modules"),
+        ("flag_ignore", "flag-ignore"),
     ):
         if key in block:
             kwargs[field] = tuple(str(v) for v in block[key])
+    if "span-writer" in block:
+        kwargs["span_writer"] = str(block["span-writer"])
     return LintConfig(**kwargs)

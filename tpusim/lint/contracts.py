@@ -1,0 +1,1265 @@
+"""Interprocedural contract pass: the stringly-typed protocols PRs 5-12 grew.
+
+The per-module rules (tpusim.lint.rules) pin JAX/device hygiene; this pass
+pins the *jax-free orchestration layer*, which is held together by string
+literals no runtime test checks until a dashboard renders "?" or a drill
+certifies a seam nothing fires:
+
+  JX010  telemetry span/attr contract — every span name and attr key a
+         consumer reads (``attrs.get("...")``, ``sp["span"] == "..."`` in
+         report/watch/tracing/convergence/fleet) must be *emitted* somewhere
+         (``recorder.emit(...)`` keywords, ``**attrs`` spreads resolved
+         through local dict construction and attr-returning helpers);
+         schema-v2 required row fields must appear in the writer's row
+         literal and in the README schema doc; raw ``["key"]`` subscripts on
+         span attrs in consumer modules are the None-intolerance bug class
+         a torn/foreign ledger turns into a dashboard crash.
+  JX011  chaos seam registry — every ``chaos.fire("seam")`` call site, the
+         README seam table and the committed ``drills/*.json`` plans must
+         agree: a drill naming a seam no code fires certifies nothing, and
+         a fired seam the table omits is an undocumented failure mode.
+  JX012  finalize leaf naming contract — every leaf name the engines store
+         into a ``run_batch`` output dict must self-describe its merge
+         (``tele_``/``stats_``/``flight_`` prefix, ``_sum``/``_max``/
+         ``_per_run`` suffix, or the scalar allowlist) so ``combine_sums``
+         cannot silently mis-merge it and the runner's strip lists cannot
+         leak it into checkpoints; the tele/per-run keys the runner and the
+         packed dispatcher read by name must be keys the engines produce.
+  JX013  CLI flag docs drift — a ``--flag`` the README (or drills/README)
+         documents that no argparse ``add_argument`` declares.
+
+Like the per-module pass, everything here is AST/text only and jax-free.
+Unlike it, the pass is *whole-project*: it reads its own configured module
+set from the repo root (plus README.md and drills/), so it only runs on the
+full-walk CLI invocation — linting one file cannot see a cross-module
+contract. Python findings honor the same ``# tpusim-lint: disable=`` comments;
+README/drill findings are baseline-only (there is no comment syntax there).
+"""
+
+from __future__ import annotations
+
+import ast
+import itertools
+import json
+import re
+from pathlib import Path
+from typing import Callable, Iterator
+
+from .config import LintConfig
+from .findings import Finding, Suppressions
+
+#: Call leaves recognized as span emitters when the first argument is a
+#: string constant (TelemetryRecorder.emit, the fleet's _emit wrapper, the
+#: recorder's span() context manager).
+_EMIT_LEAVES = frozenset({"emit", "_emit", "span"})
+
+#: emit() keyword-only parameters that are row fields, not attrs.
+_ROW_KEYWORDS = frozenset({"t_start", "dur_s"})
+
+
+def _const_str(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _attr_leaf(func: ast.AST) -> str | None:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def scope_nodes(scope: ast.AST):
+    """Walk one scope's nodes. For a Module, do NOT descend into function
+    bodies: every function is scanned as its own scope, and merging all
+    functions' locals into one module-wide namespace would both manufacture
+    cross-function false positives (an unrelated function's same-named
+    local classified as span attrs) and hide real drift (an unrelated
+    local's dict stores inflating the emitted-key set)."""
+    if not isinstance(scope, ast.Module):
+        yield from ast.walk(scope)
+        return
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# String-possibility resolution: which constant strings can an expression be?
+
+
+class StrEnv:
+    """Possible constant-string bindings of local names: loop targets over
+    constant tuples, dict-literal key sets, and module-level constant tuples
+    (resolved across the scanned module set, import-from aliases included)."""
+
+    def __init__(self, module: "ModuleFacts", func: ast.AST):
+        self.names: dict[str, set[str]] = {}
+        self.module = module
+        for node in scope_nodes(func):
+            if isinstance(node, (ast.For, ast.AsyncFor)) or isinstance(
+                node, ast.comprehension
+            ):
+                it = node.iter
+                targets = node.target
+                consts = self._iterable_strings(it)
+                if consts is None:
+                    continue
+                if isinstance(targets, ast.Name):
+                    self.names.setdefault(targets.id, set()).update(consts)
+                elif isinstance(targets, (ast.Tuple, ast.List)) and targets.elts:
+                    # ``for name, _, _ in STATS`` binds the FIRST element;
+                    # the module-tuple resolver already projected to it.
+                    first = targets.elts[0]
+                    if isinstance(first, ast.Name):
+                        self.names.setdefault(first.id, set()).update(consts)
+
+    def _iterable_strings(self, it: ast.AST) -> set[str] | None:
+        if isinstance(it, (ast.Tuple, ast.List, ast.Set)):
+            out = {s for s in (_const_str(e) for e in it.elts) if s is not None}
+            return out or None
+        if isinstance(it, ast.Call):
+            leaf = _attr_leaf(it.func)
+            if leaf in ("items", "keys") and isinstance(it.func, ast.Attribute):
+                base = it.func.value
+                if isinstance(base, ast.Name):
+                    keys = self.module.local_dict_keys.get(base.id)
+                    if keys:
+                        return keys
+            return None
+        if isinstance(it, ast.Name):
+            # Iterating a dict name yields its keys.
+            return (
+                self.module.resolve_const_tuple(it.id)
+                or self.module.local_dict_keys.get(it.id)
+            )
+        return None
+
+    def possible(self, e: ast.AST) -> set[str] | None:
+        """All constant strings ``e`` can evaluate to, or None if open."""
+        s = _const_str(e)
+        if s is not None:
+            return {s}
+        if isinstance(e, ast.Name):
+            got = self.names.get(e.id)
+            if got:
+                return got
+            return self.module.resolve_const_tuple(e.id)
+        if isinstance(e, ast.JoinedStr):
+            parts: list[set[str]] = []
+            for v in e.values:
+                if isinstance(v, ast.Constant):
+                    parts.append({str(v.value)})
+                elif isinstance(v, ast.FormattedValue):
+                    sub = self.possible(v.value)
+                    if sub is None:
+                        return None
+                    parts.append(sub)
+                else:
+                    return None
+            out = {"".join(c) for c in itertools.product(*parts)}
+            return out if len(out) <= 64 else None
+        if isinstance(e, ast.BinOp) and isinstance(e.op, ast.Add):
+            left, right = self.possible(e.left), self.possible(e.right)
+            if left is None or right is None:
+                return None
+            out = {a + b for a in left for b in right}
+            return out if len(out) <= 64 else None
+        return None
+
+
+class ModuleFacts:
+    """One parsed module plus the cheap global facts the resolvers need."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        self.suppressions = Suppressions(source)
+        self.suppressions.extend_spans(self.tree)
+        #: module-level NAME -> tuple/list of string constants (or of tuples,
+        #: projected to their first string element — the STATS shape).
+        self.const_tuples: dict[str, set[str]] = {}
+        #: module-level NAME -> single string constant.
+        self.const_strs: dict[str, str] = {}
+        #: import-from aliases: local name -> (module leaf, original name).
+        self.imports: dict[str, tuple[str, str]] = {}
+        #: function-scope dict literals by name (best effort, last wins) —
+        #: the StrEnv ``for k in hist_run`` resolution source.
+        self.local_dict_keys: dict[str, set[str]] = {}
+        #: all modules, injected by the project pass for import resolution.
+        self.project: dict[str, "ModuleFacts"] = {}
+        self._collect()
+
+    def _collect(self) -> None:
+        for node in self.tree.body:
+            if isinstance(node, ast.ImportFrom) and node.module:
+                leaf = node.module.rsplit(".", 1)[-1]
+                for alias in node.names:
+                    self.imports[alias.asname or alias.name] = (leaf, alias.name)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if not isinstance(tgt, ast.Name):
+                    continue
+                s = _const_str(node.value)
+                if s is not None:
+                    self.const_strs[tgt.id] = s
+                elif isinstance(node.value, (ast.Tuple, ast.List)):
+                    out: set[str] = set()
+                    for e in node.value.elts:
+                        s = _const_str(e)
+                        if s is not None:
+                            out.add(s)
+                        elif isinstance(e, (ast.Tuple, ast.List)) and e.elts:
+                            s = _const_str(e.elts[0])
+                            if s is not None:
+                                out.add(s)
+                    if out:
+                        self.const_tuples[tgt.id] = out
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+                keys = {
+                    s for s in (_const_str(k) for k in node.value.keys if k)
+                    if s is not None
+                }
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and keys:
+                        self.local_dict_keys.setdefault(tgt.id, set()).update(keys)
+
+    def resolve_const_tuple(self, name: str) -> set[str] | None:
+        if name in self.const_tuples:
+            return self.const_tuples[name]
+        if name in self.imports:
+            mod_leaf, orig = self.imports[name]
+            other = self.project.get(mod_leaf)
+            if other is not None and orig in other.const_tuples:
+                return other.const_tuples[orig]
+        return None
+
+    def resolve_const_str(self, name: str) -> str | None:
+        if name in self.const_strs:
+            return self.const_strs[name]
+        if name in self.imports:
+            mod_leaf, orig = self.imports[name]
+            other = self.project.get(mod_leaf)
+            if other is not None:
+                return other.const_strs.get(orig)
+        return None
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        text = self.lines[line - 1].strip() if 0 < line <= len(self.lines) else ""
+        return Finding(rule, self.path, line, col, message, text)
+
+
+# ---------------------------------------------------------------------------
+# Emitted-side extraction (spans + attr keys), interprocedural.
+
+
+class EmitExtractor:
+    """Union of span names and attr keys any emit site can produce, with
+    ``**spread`` arguments resolved through local dict construction
+    (literals, ``dict(...)``, subscript stores, ``.update(...)``) and
+    through attr-returning helpers by simple name (``environment_attrs``,
+    ``memory_attrs``, ``summary_attrs`` — whatever the scanned modules
+    define). Over-approximate by design: an extra emitted key only weakens
+    JX010, a missed one breaks the dogfood, so unresolvable spreads are
+    skipped rather than poisoning the whole span space."""
+
+    def __init__(self, modules: list[ModuleFacts], config: LintConfig):
+        self.modules = modules
+        self.config = config
+        self.spans: set[str] = set()
+        self.attr_keys: set[str] = set()
+        #: function simple name -> dict keys its returned dicts can carry.
+        self._fn_keys: dict[str, set[str]] = {}
+        self._fn_defs: dict[str, list[tuple[ModuleFacts, ast.AST]]] = {}
+        for m in modules:
+            for node in ast.walk(m.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._fn_defs.setdefault(node.name, []).append((m, node))
+        for m in modules:
+            self._scan_module(m)
+
+    # -- helper-function return keys ------------------------------------
+
+    def fn_return_keys(self, name: str, _seen: frozenset = frozenset()) -> set[str]:
+        if name in self._fn_keys:
+            return self._fn_keys[name]
+        if name in _seen:
+            return set()
+        out: set[str] = set()
+        for m, fn in self._fn_defs.get(name, []):
+            returned: list[ast.AST] = [
+                r.value for r in ast.walk(fn)
+                if isinstance(r, ast.Return) and r.value is not None
+            ]
+            env = StrEnv(m, fn)
+            for value in returned:
+                out |= self._dict_expr_keys(m, fn, env, value, _seen | {name})
+        self._fn_keys[name] = out
+        return out
+
+    def _dict_expr_keys(
+        self, m: ModuleFacts, scope: ast.AST, env: StrEnv, e: ast.AST,
+        _seen: frozenset = frozenset(),
+    ) -> set[str]:
+        """Keys a dict-valued expression can carry."""
+        out: set[str] = set()
+        if isinstance(e, ast.Dict):
+            for k, v in zip(e.keys, e.values):
+                if k is None:  # ``**inner`` inside a literal
+                    out |= self._dict_expr_keys(m, scope, env, v, _seen)
+                else:
+                    ks = env.possible(k)
+                    if ks:
+                        out |= ks
+        elif isinstance(e, ast.Call):
+            leaf = _attr_leaf(e.func)
+            if leaf == "dict":
+                for kw in e.keywords:
+                    if kw.arg:
+                        out.add(kw.arg)
+                    else:
+                        out |= self._dict_expr_keys(m, scope, env, kw.value, _seen)
+            elif leaf:
+                out |= self.fn_return_keys(leaf, _seen)
+        elif isinstance(e, ast.Name):
+            out |= self._local_dict_keys(m, scope, env, e.id, _seen)
+        elif isinstance(e, ast.IfExp):
+            out |= self._dict_expr_keys(m, scope, env, e.body, _seen)
+            out |= self._dict_expr_keys(m, scope, env, e.orelse, _seen)
+        elif isinstance(e, ast.DictComp):
+            # ``{k: v for k, v in NAME.items() if ...}`` — the fleet summary
+            # re-spread; keys come from the iterated dict.
+            it = e.generators[0].iter if e.generators else None
+            if isinstance(it, ast.Call) and _attr_leaf(it.func) == "items":
+                base = it.func.value  # type: ignore[union-attr]
+                if isinstance(base, ast.Name):
+                    out |= self._local_dict_keys(m, scope, env, base.id, _seen)
+        elif isinstance(e, ast.BoolOp):
+            for v in e.values:
+                out |= self._dict_expr_keys(m, scope, env, v, _seen)
+        return out
+
+    def _local_dict_keys(
+        self, m: ModuleFacts, scope: ast.AST, env: StrEnv, name: str,
+        _seen: frozenset = frozenset(),
+    ) -> set[str]:
+        """Keys the local dict ``name`` can hold inside ``scope``: literal/
+        dict() assignments, constant subscript stores, and .update() calls."""
+        out: set[str] = set()
+        for node in scope_nodes(scope):
+            if isinstance(node, ast.Assign):
+                if any(
+                    isinstance(t, ast.Name) and t.id == name for t in node.targets
+                ) and not isinstance(node.value, ast.Name):
+                    out |= self._dict_expr_keys(m, scope, env, node.value, _seen)
+            elif isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, ast.Store
+            ):
+                if isinstance(node.value, ast.Name) and node.value.id == name:
+                    ks = env.possible(node.slice)
+                    if ks:
+                        out |= ks
+            elif isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ):
+                if (
+                    node.func.attr == "update"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == name
+                ):
+                    for kw in node.keywords:
+                        if kw.arg:
+                            out.add(kw.arg)
+                    for a in node.args:
+                        out |= self._dict_expr_keys(m, scope, env, a, _seen)
+        return out
+
+    # -- emit-site scan ---------------------------------------------------
+
+    def _scan_module(self, m: ModuleFacts) -> None:
+        funcs = [
+            n for n in ast.walk(m.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ] + [m.tree]
+        for scope in funcs:
+            env: StrEnv | None = None
+            for node in scope_nodes(scope):
+                if not isinstance(node, ast.Call):
+                    continue
+                leaf = _attr_leaf(node.func)
+                if leaf in self.config.context_methods:
+                    # CompileLedger.set_context(...) keywords flow into every
+                    # later ``compile`` span via ``**self._context``.
+                    for kw in node.keywords:
+                        if kw.arg:
+                            self.attr_keys.add(kw.arg)
+                    continue
+                if leaf not in _EMIT_LEAVES or not node.args:
+                    continue
+                span = _const_str(node.args[0])
+                if span is None:
+                    continue
+                self.spans.add(span)
+                if env is None:
+                    env = StrEnv(m, scope)
+                for kw in node.keywords:
+                    if kw.arg:
+                        if kw.arg not in _ROW_KEYWORDS:
+                            self.attr_keys.add(kw.arg)
+                    else:
+                        self.attr_keys |= self._dict_expr_keys(
+                            m, scope, env, kw.value
+                        )
+
+
+# ---------------------------------------------------------------------------
+# Consumed-side extraction: an abstract classifier anchored on the literal
+# "attrs"/"span" row fields.
+
+_ATTRS = "attrs"
+_ATTRS_COLL = "attrs_coll"
+_SPAN = "span_name"
+_SPAN_COLL = "span_coll"
+_SPAN_KEYED = "span_keyed"
+
+
+class ConsumeExtractor:
+    """Span names and attr keys one module's dashboards *read*.
+
+    The anchor is structural, not nominal: any ``X.get("attrs")`` /
+    ``X["attrs"]`` read marks a span-attrs value, any ``X["span"]`` /
+    ``X.get("span")`` a span name — then a small fixpoint propagates those
+    classifications through local assignment, ``or {}`` defaulting,
+    comprehensions, collections and span-keyed dicts
+    (``by.setdefault(sp["span"], [])``). Nested payloads (the per-stat
+    entries under a ``stats`` attr) are deliberately out of scope: they are
+    one more level of protocol than the emit side can resolve, and flagging
+    them would be noise, not teeth."""
+
+    def __init__(self, m: ModuleFacts):
+        self.m = m
+        #: (key, node) consumed attr keys.
+        self.attr_reads: list[tuple[str, ast.AST]] = []
+        #: (name, node) consumed span names.
+        self.span_reads: list[tuple[str, ast.AST]] = []
+        #: (prefix, node) consumed span-name prefixes (.startswith).
+        self.span_prefixes: list[tuple[str, ast.AST]] = []
+        #: raw ``[...]`` subscript reads on attrs values (None-intolerant).
+        self.raw_subscripts: list[tuple[str, ast.AST]] = []
+        funcs = [
+            n for n in ast.walk(m.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ] + [m.tree]
+        for scope in funcs:
+            self._scan_scope(scope)
+
+    # -- classification ----------------------------------------------------
+
+    def _classify(self, e: ast.AST, names: dict[str, set[str]]) -> set[str]:
+        if isinstance(e, ast.Name) and isinstance(e.ctx, ast.Load):
+            return names.get(e.id, set())
+        if isinstance(e, ast.Call):
+            leaf = _attr_leaf(e.func)
+            if leaf == "get" and isinstance(e.func, ast.Attribute) and e.args:
+                key = _const_str(e.args[0])
+                base = self._classify(e.func.value, names)
+                if key == "attrs":
+                    return {_ATTRS}
+                if key == "span":
+                    return {_SPAN}
+                if _ATTRS_COLL in base:
+                    return {_ATTRS}
+                return set()
+            if leaf in ("str",) and len(e.args) == 1:
+                return self._classify(e.args[0], names) & {_SPAN}
+            if leaf in ("list", "sorted", "set", "tuple") and e.args:
+                return self._classify(e.args[0], names) & {
+                    _ATTRS_COLL, _SPAN_COLL
+                }
+            return set()
+        if isinstance(e, ast.Subscript) and isinstance(e.ctx, ast.Load):
+            key = _const_str(e.slice)
+            base = self._classify(e.value, names)
+            if key == "attrs":
+                return {_ATTRS}
+            if key == "span":
+                return {_SPAN}
+            if _ATTRS_COLL in base:
+                return {_ATTRS}
+            return set()
+        if isinstance(e, ast.BoolOp):
+            out: set[str] = set()
+            for v in e.values:
+                out |= self._classify(v, names)
+            return out
+        if isinstance(e, ast.IfExp):
+            return self._classify(e.body, names) | self._classify(
+                e.orelse, names
+            )
+        if isinstance(e, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+            elt = self._classify(e.elt, names)
+            out = set()
+            if _ATTRS in elt:
+                out.add(_ATTRS_COLL)
+            if _SPAN in elt:
+                out.add(_SPAN_COLL)
+            return out
+        if isinstance(e, ast.DictComp):
+            if _ATTRS in self._classify(e.value, names):
+                return {_ATTRS_COLL}
+            return set()
+        return set()
+
+    # -- fixpoint over one scope -------------------------------------------
+
+    def _scan_scope(self, scope: ast.AST) -> None:
+        names: dict[str, set[str]] = {}
+
+        def bind(n: str, kinds: set[str]) -> bool:
+            if not kinds:
+                return False
+            cur = names.setdefault(n, set())
+            if kinds - cur:
+                cur |= kinds
+                return True
+            return False
+
+        changed = True
+        while changed:
+            changed = False
+            for node in scope_nodes(scope):
+                if isinstance(node, (ast.Assign, ast.AnnAssign, ast.NamedExpr)):
+                    value = getattr(node, "value", None)
+                    if value is None:
+                        continue
+                    kinds = self._classify(value, names)
+                    targets = (
+                        node.targets if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            changed |= bind(t.id, kinds)
+                        elif isinstance(t, ast.Subscript) and isinstance(
+                            t.value, ast.Name
+                        ):
+                            # ``latest[pt] = attrs`` / ``by[sp["span"]] = x``
+                            if _ATTRS in kinds:
+                                changed |= bind(t.value.id, {_ATTRS_COLL})
+                            if _SPAN in self._classify(t.slice, names):
+                                changed |= bind(t.value.id, {_SPAN_KEYED})
+                elif isinstance(node, (ast.For, ast.AsyncFor)):
+                    kinds = self._classify(node.iter, names)
+                    tgt_kinds: set[str] = set()
+                    if _ATTRS_COLL in kinds:
+                        tgt_kinds.add(_ATTRS)
+                    if _SPAN_COLL in kinds:
+                        tgt_kinds.add(_SPAN)
+                    if isinstance(node.target, ast.Name):
+                        changed |= bind(node.target.id, tgt_kinds)
+                elif isinstance(node, ast.comprehension):
+                    kinds = self._classify(node.iter, names)
+                    tgt_kinds = set()
+                    if _ATTRS_COLL in kinds:
+                        tgt_kinds.add(_ATTRS)
+                    if _SPAN_COLL in kinds:
+                        tgt_kinds.add(_SPAN)
+                    if isinstance(node.target, ast.Name):
+                        changed |= bind(node.target.id, tgt_kinds)
+                elif isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute
+                ):
+                    if (
+                        node.func.attr == "setdefault"
+                        and isinstance(node.func.value, ast.Name)
+                        and node.args
+                        and _SPAN in self._classify(node.args[0], names)
+                    ):
+                        changed |= bind(node.func.value.id, {_SPAN_KEYED})
+
+        env = StrEnv(self.m, scope)
+        for node in scope_nodes(scope):
+            self._collect_reads(node, names, env)
+
+    def _collect_reads(
+        self, node: ast.AST, names: dict[str, set[str]], env: StrEnv
+    ) -> None:
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            leaf = node.func.attr
+            base = self._classify(node.func.value, names)
+            if leaf == "get" and node.args:
+                key = _const_str(node.args[0])
+                if _ATTRS in base:
+                    keys = env.possible(node.args[0])
+                    for k in keys or ():
+                        self.attr_reads.append((k, node))
+                elif _SPAN_KEYED in base and key is not None:
+                    self.span_reads.append((key, node))
+                # also the DEFAULT expression can consume: a.get("x", a.get("y"))
+                # is walked on its own by ast.walk.
+            elif leaf == "startswith" and node.args and _SPAN in base:
+                pref = _const_str(node.args[0])
+                if pref is not None:
+                    self.span_prefixes.append((pref, node))
+            elif leaf == "pop" and node.args and _ATTRS in base:
+                keys = env.possible(node.args[0])
+                for k in keys or ():
+                    self.attr_reads.append((k, node))
+        elif isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
+            base = self._classify(node.value, names)
+            key = _const_str(node.slice)
+            if _ATTRS in base:
+                keys = env.possible(node.slice)
+                for k in keys or ():
+                    self.attr_reads.append((k, node))
+                label = key if key is not None else "<dynamic>"
+                self.raw_subscripts.append((label, node))
+            elif _SPAN_KEYED in base and key is not None:
+                self.span_reads.append((key, node))
+        elif isinstance(node, ast.Compare):
+            sides = [node.left, *node.comparators]
+            classes = [self._classify(s, names) for s in sides]
+            for i, op in enumerate(node.ops):
+                a, b = sides[i], sides[i + 1]
+                ca, cb = classes[i], classes[i + 1]
+                if isinstance(op, (ast.Eq, ast.NotEq)):
+                    for expr, cls, other in ((a, ca, b), (b, cb, a)):
+                        if _SPAN in cls:
+                            s = _const_str(other)
+                            if s is not None:
+                                self.span_reads.append((s, node))
+                elif isinstance(op, (ast.In, ast.NotIn)):
+                    # "x" in span-coll / span-keyed, or span-name in ("a","b"),
+                    # or "key" in attrs.
+                    s = _const_str(a)
+                    if s is not None and (
+                        {_SPAN_COLL, _SPAN_KEYED} & cb
+                    ):
+                        self.span_reads.append((s, node))
+                    elif s is not None and _ATTRS in cb:
+                        self.attr_reads.append((s, node))
+                    elif _SPAN in ca and isinstance(
+                        b, (ast.Tuple, ast.List, ast.Set)
+                    ):
+                        for e in b.elts:
+                            s = _const_str(e)
+                            if s is not None:
+                                self.span_reads.append((s, node))
+
+
+# ---------------------------------------------------------------------------
+# Project context: parse everything once, run the four rules.
+
+
+class ProjectContracts:
+    def __init__(self, root: Path, config: LintConfig):
+        self.root = Path(root)
+        self.config = config
+        self.modules: dict[str, ModuleFacts] = {}
+        self._docs: dict[str, list[str]] = {}
+        self._emits: EmitExtractor | None = None
+        for rel in config.telemetry_modules:
+            self._load(rel)
+
+    @property
+    def emits(self) -> "EmitExtractor":
+        # Lazy: only the JX010 check reads the emitted-side extraction, and
+        # a `--rules JX011` invocation should not pay the interprocedural
+        # spread/helper fixpoints over 13 modules for nothing.
+        if self._emits is None:
+            self._emits = EmitExtractor(
+                [self.modules[r] for r in self.config.telemetry_modules
+                 if r in self.modules],
+                self.config,
+            )
+        return self._emits
+
+    def _load(self, rel: str) -> ModuleFacts | None:
+        if rel in self.modules:
+            return self.modules[rel]
+        p = self.root / rel
+        if not p.exists():
+            return None
+        try:
+            facts = ModuleFacts(rel, p.read_text())
+        except SyntaxError:
+            return None
+        self.modules[rel] = facts
+        # Import resolution is by module *leaf* name (convergence.STATS).
+        for m in self.modules.values():
+            m.project[Path(rel).stem] = facts
+            facts.project[Path(m.path).stem] = m
+        return facts
+
+    def _doc_lines(self, rel: str) -> list[str]:
+        # Memoized like the Python-module cache: the rules re-anchor finding
+        # text per doc finding, and an N-row drift must not re-read the
+        # whole README N times.
+        cached = self._docs.get(rel)
+        if cached is not None:
+            return cached
+        p = self.root / rel
+        lines = p.read_text().splitlines() if p.exists() else []
+        self._docs[rel] = lines
+        return lines
+
+    def _doc_finding(
+        self, rule: str, rel: str, lineno: int, message: str,
+        lines: list[str], col: int = 0,
+    ) -> Finding:
+        text = lines[lineno - 1].strip() if 0 < lineno <= len(lines) else ""
+        return Finding(rule, rel, lineno, col, message, text)
+
+    # -- JX010 -------------------------------------------------------------
+
+    def check_telemetry(self) -> Iterator[Finding]:
+        emitted_spans = self.emits.spans
+        emitted_keys = self.emits.attr_keys
+        for rel in self.config.telemetry_modules:
+            m = self.modules.get(rel)
+            if m is None:
+                continue
+            cons = ConsumeExtractor(m)
+            for key, node in cons.attr_reads:
+                if key not in emitted_keys:
+                    yield m.finding(
+                        "JX010", node,
+                        f"span attr `{key}` is consumed here but no emit "
+                        f"site in the telemetry modules ever produces it — "
+                        f"a renamed or dropped producer key renders this "
+                        f"panel as permanent n/a",
+                    )
+            for name, node in cons.span_reads:
+                if name not in emitted_spans:
+                    yield m.finding(
+                        "JX010", node,
+                        f"span name `{name}` is consumed here but never "
+                        f"emitted by any producer — dead dashboard branch "
+                        f"or renamed span",
+                    )
+            for pref, node in cons.span_prefixes:
+                if not any(s.startswith(pref) for s in emitted_spans):
+                    yield m.finding(
+                        "JX010", node,
+                        f"span-name prefix `{pref}` matches no emitted span",
+                    )
+            for label, node in cons.raw_subscripts:
+                yield m.finding(
+                    "JX010", node,
+                    f"raw `[{label!r}]` subscript on span attrs — a torn or "
+                    f"foreign ledger row raises KeyError/TypeError in the "
+                    f"dashboard; use `.get()` with a None-tolerant default",
+                )
+        yield from self._check_schema()
+
+    def _check_schema(self) -> Iterator[Finding]:
+        writer = self.config.span_writer
+        required = set(self.config.span_schema_required)
+        if not writer or not required:
+            return
+        rel, _, qual = writer.partition(":")
+        m = self._load(rel)
+        if m is None:
+            return
+        parts = qual.split(".")
+        node: ast.AST | None = m.tree
+        for part in parts:
+            found = None
+            for child in ast.walk(node):  # type: ignore[arg-type]
+                if isinstance(
+                    child, (ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and child.name == part:
+                    found = child
+                    break
+            node = found
+            if node is None:
+                break
+        if node is None:
+            yield self._doc_finding(
+                "JX010", rel, 1,
+                f"span writer `{qual}` not found in {rel} — the schema "
+                f"contract check has nothing to pin (config drift)",
+                m.lines,
+            )
+            return
+        row_keys: set[str] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Dict):
+                for k in sub.keys:
+                    s = _const_str(k) if k is not None else None
+                    if s is not None:
+                        row_keys.add(s)
+        missing = sorted(required - row_keys)
+        if missing:
+            yield m.finding(
+                "JX010", node,
+                f"span row literal in `{qual}` omits required schema-v2 "
+                f"field(s) {missing} — consumers treat these as the row "
+                f"contract (config span-schema-required)",
+            )
+        # README schema doc cross-check, marker-anchored. A missing marker
+        # is itself a finding: an uncheckable schema doc rots silently.
+        saw_marker = False
+        for doc in self.config.doc_files:
+            lines = self._doc_lines(doc)
+            for i, line in enumerate(lines, start=1):
+                if "tpusim-lint: span-schema" not in line:
+                    continue
+                saw_marker = True
+                blob = " ".join(lines[i:i + 6])
+                mjson = re.search(r"\{[^}]*\}", blob)
+                doc_fields = set(re.findall(r'"([a-z_]+)"', mjson.group(0))) \
+                    if mjson else set()
+                for f in sorted(required - doc_fields):
+                    yield self._doc_finding(
+                        "JX010", doc, i,
+                        f"span-schema doc omits required field `{f}` "
+                        f"(schema v2; the row literal in {rel} is the "
+                        f"source of truth)",
+                        lines,
+                    )
+                for f in sorted(doc_fields - row_keys):
+                    yield self._doc_finding(
+                        "JX010", doc, i,
+                        f"span-schema doc lists `{f}` which the writer's "
+                        f"row literal never produces",
+                        lines,
+                    )
+        if not saw_marker and self.config.doc_files:
+            doc = self.config.doc_files[0]
+            yield self._doc_finding(
+                "JX010", doc, 1,
+                "no `tpusim-lint: span-schema` marker found in the doc "
+                "files — the span-schema doc cannot be cross-checked (add "
+                "the marker comment above the schema line)",
+                self._doc_lines(doc),
+            )
+
+    # -- JX011 -------------------------------------------------------------
+
+    def _fired_seams(self) -> dict[str, tuple[ModuleFacts, ast.AST]]:
+        fired: dict[str, tuple[ModuleFacts, ast.AST]] = {}
+        for rel in self._include_files():
+            m = self._load(rel)
+            if m is None:
+                continue
+            for node in ast.walk(m.tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and _attr_leaf(node.func) == "fire"
+                    and node.args
+                ):
+                    seam = _const_str(node.args[0])
+                    if seam is not None:
+                        fired.setdefault(seam, (m, node))
+        return fired
+
+    def _include_files(self) -> list[str]:
+        out = []
+        for pattern in self.config.include:
+            for p in sorted(self.root.glob(pattern)):
+                rel = p.relative_to(self.root).as_posix()
+                if self.config.is_included(rel) and rel not in out:
+                    out.append(rel)
+        return out
+
+    def _readme_seams(self) -> tuple[dict[str, tuple[str, int]], bool]:
+        """Seam names from the marker-anchored README table:
+        name -> (doc path, line)."""
+        seams: dict[str, tuple[str, int]] = {}
+        saw_marker = False
+        for doc in self.config.doc_files:
+            lines = self._doc_lines(doc)
+            armed = in_table = False
+            for i, line in enumerate(lines, start=1):
+                if "tpusim-lint: chaos-seam-table" in line:
+                    saw_marker = armed = True
+                    continue
+                is_row = line.lstrip().startswith("|")
+                if armed and is_row:
+                    armed, in_table = False, True
+                if in_table:
+                    mrow = re.match(r"\s*\|\s*`([A-Za-z0-9_.]+)`\s*\|", line)
+                    if mrow:
+                        seams.setdefault(mrow.group(1), (doc, i))
+                    elif not is_row:
+                        in_table = False
+        return seams, saw_marker
+
+    def check_chaos_seams(self) -> Iterator[Finding]:
+        fired = self._fired_seams()
+        documented, saw_marker = self._readme_seams()
+        if not saw_marker and self.config.doc_files:
+            doc = self.config.doc_files[0]
+            yield self._doc_finding(
+                "JX011", doc, 1,
+                "no `tpusim-lint: chaos-seam-table` marker found in the doc "
+                "files — the seam table cannot be cross-checked (add the "
+                "marker comment above the fault-point table)",
+                self._doc_lines(doc),
+            )
+        for seam, (doc, line) in sorted(documented.items()):
+            if seam not in fired:
+                yield self._doc_finding(
+                    "JX011", doc, line,
+                    f"documented chaos seam `{seam}` is fired by no "
+                    f"`chaos.fire(...)` call site — stale table row or "
+                    f"renamed seam",
+                    self._doc_lines(doc),
+                )
+        for seam, (m, node) in sorted(fired.items()):
+            if saw_marker and seam not in documented:
+                yield m.finding(
+                    "JX011", node,
+                    f"chaos seam `{seam}` is fired here but missing from "
+                    f"the documented seam table — an undocumented failure "
+                    f"mode no drill can target by contract",
+                )
+        for pattern in self.config.drill_globs:
+            for p in sorted(self.root.glob(pattern)):
+                rel = p.relative_to(self.root).as_posix()
+                try:
+                    text = p.read_text()
+                    plan = json.loads(text)
+                except (OSError, json.JSONDecodeError):
+                    plan = None
+                # Valid JSON of the wrong SHAPE (a top-level list, a string
+                # fault entry) is just as broken as unparseable JSON — and
+                # must be a finding, not an analyzer AttributeError.
+                faults = plan.get("faults", []) if isinstance(plan, dict) else None
+                if not isinstance(faults, list) or not all(
+                    isinstance(f, dict) for f in faults
+                ):
+                    yield Finding(
+                        "JX011", rel, 1, 0,
+                        "drill plan is unreadable/unparseable (not a "
+                        '{"faults": [{...}]} object) — a broken committed '
+                        "drill certifies nothing",
+                    )
+                    continue
+                lines = text.splitlines()
+                for fault in faults:
+                    point = fault.get("point")
+                    if not isinstance(point, str) or point in fired:
+                        continue
+                    lineno = next(
+                        (i for i, ln in enumerate(lines, start=1)
+                         if f'"{point}"' in ln), 1,
+                    )
+                    yield self._doc_finding(
+                        "JX011", rel, lineno,
+                        f"drill names seam `{point}` which no code fires — "
+                        f"the drill can never inject and silently certifies "
+                        f"an undrilled recovery path",
+                        lines,
+                    )
+
+    # -- JX012 -------------------------------------------------------------
+
+    def _leaf_stores(self) -> dict[str, tuple[ModuleFacts, ast.AST]]:
+        stores: dict[str, tuple[ModuleFacts, ast.AST]] = {}
+        dict_names = set(self.config.leaf_dict_names)
+        for rel in self.config.engine_leaf_modules:
+            m = self._load(rel)
+            if m is None:
+                continue
+            funcs = [
+                n for n in ast.walk(m.tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+            for fn in funcs:
+                env = StrEnv(m, fn)
+                for node in ast.walk(fn):
+                    if (
+                        isinstance(node, ast.Subscript)
+                        and isinstance(node.ctx, ast.Store)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id in dict_names
+                    ):
+                        for k in env.possible(node.slice) or ():
+                            stores.setdefault(k, (m, node))
+                    elif (
+                        isinstance(node, ast.Return)
+                        and isinstance(node.value, ast.Dict)
+                        and "finalize" in fn.name
+                    ):
+                        for k in node.value.keys:
+                            s = _const_str(k) if k is not None else None
+                            if s is not None:
+                                stores.setdefault(s, (m, node))
+            # dict literals ASSIGNED to the configured names
+            # (loop_out_specs = {...}) carry leaf keys too.
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Dict
+                ):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name) and tgt.id in dict_names:
+                            for k in node.value.keys:
+                                s = _const_str(k) if k is not None else None
+                                if s is not None:
+                                    stores.setdefault(s, (m, node))
+        return stores
+
+    def _leaf_class(self, leaf: str) -> bool:
+        c = self.config
+        return (
+            leaf.startswith(tuple(c.leaf_strip_prefixes))
+            or leaf.endswith(tuple(c.leaf_merge_suffixes))
+            or leaf in c.leaf_scalar_allowlist
+        )
+
+    def check_finalize_leaves(self) -> Iterator[Finding]:
+        stores = self._leaf_stores()
+        c = self.config
+        # (1) Naming-contract: every stored leaf self-describes its merge.
+        for leaf, (m, node) in sorted(stores.items()):
+            if not self._leaf_class(leaf):
+                yield m.finding(
+                    "JX012", node,
+                    f"finalize leaf `{leaf}` matches no merge class "
+                    f"(prefixes {sorted(c.leaf_strip_prefixes)}, suffixes "
+                    f"{sorted(c.leaf_merge_suffixes)}, scalars "
+                    f"{sorted(c.leaf_scalar_allowlist)}) — combine_sums "
+                    f"would silently ADD it and the runner would checkpoint "
+                    f"it; name the merge semantics into the leaf",
+                )
+        # (2) combine_sums must implement the configured merge literals.
+        engine_rel = c.engine_leaf_modules[0] if c.engine_leaf_modules else None
+        if engine_rel:
+            m = self.modules.get(engine_rel) or self._load(engine_rel)
+            if m is not None:
+                fn = next(
+                    (n for n in ast.walk(m.tree)
+                     if isinstance(n, ast.FunctionDef)
+                     and n.name == "combine_sums"), None,
+                )
+                if fn is None:
+                    yield Finding(
+                        "JX012", engine_rel, 1, 0,
+                        "combine_sums not found — the merge-rule contract "
+                        "check has nothing to pin",
+                    )
+                else:
+                    lits: set[str] = set()
+                    for node in ast.walk(fn):
+                        if (
+                            isinstance(node, ast.Call)
+                            and _attr_leaf(node.func)
+                            in ("startswith", "endswith")
+                            and node.args
+                        ):
+                            s = _const_str(node.args[0])
+                            if s is None and isinstance(node.args[0], ast.Name):
+                                s = m.resolve_const_str(node.args[0].id)
+                            if s is not None:
+                                lits.add(s)
+                    for miss in sorted(set(c.combine_merge_literals) - lits):
+                        yield m.finding(
+                            "JX012", fn,
+                            f"combine_sums no longer tests the merge-rule "
+                            f"literal `{miss}` the leaf contract declares — "
+                            f"leaves of that class would fall through to "
+                            f"the additive default",
+                        )
+        # (3) Runner strip list covers every telemetry prefix.
+        strip_rel = c.leaf_consumer_modules[0] if c.leaf_consumer_modules else None
+        if strip_rel:
+            m = self._load(strip_rel)
+            if m is not None:
+                strip_lits: set[str] = set()
+                for node in ast.walk(m.tree):
+                    if (
+                        isinstance(node, ast.Call)
+                        and _attr_leaf(node.func) == "startswith"
+                        and node.args
+                    ):
+                        s = _const_str(node.args[0])
+                        if s is not None:
+                            strip_lits.add(s)
+                for pref in sorted(set(c.leaf_strip_prefixes) - strip_lits):
+                    yield Finding(
+                        "JX012", strip_rel, 1, 0,
+                        f"runner never strips the `{pref}` telemetry prefix "
+                        f"(no startswith literal) — leaves of that class "
+                        f"would leak into the stat/checkpoint path",
+                    )
+        # (4) Consumed leaf keys must be produced — scoped to the dict
+        # receivers that hold engine run_batch outputs (leaf-read-names), so
+        # summary/config dicts that reuse a leaf-ish suffix stay out.
+        produced = set(stores)
+        read_names = set(c.leaf_read_names)
+        for rel in c.leaf_consumer_modules:
+            m = self._load(rel)
+            if m is None:
+                continue
+            funcs = [
+                n for n in ast.walk(m.tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ] + [m.tree]
+            for fn in funcs:
+                env = StrEnv(m, fn)
+
+                def key_strings(e: ast.AST) -> set[str]:
+                    # Constant keys and f-string/concat patterns only: a bare
+                    # Name key is generic dict iteration (the strip
+                    # comprehensions), not a named leaf read — and StrEnv's
+                    # function-wide merge of same-named loop targets would
+                    # mis-resolve it.
+                    if isinstance(e, ast.Name):
+                        return set()
+                    return env.possible(e) or set()
+
+                for node in scope_nodes(fn):
+                    keys: set[str] = set()
+                    if (
+                        isinstance(node, ast.Subscript)
+                        and isinstance(node.ctx, ast.Load)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id in read_names
+                    ):
+                        keys = key_strings(node.slice)
+                    elif (
+                        isinstance(node, ast.Call)
+                        and _attr_leaf(node.func) in ("get", "pop")
+                        and isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id in read_names
+                        and node.args
+                    ):
+                        keys = key_strings(node.args[0])
+                    for k in keys - produced:
+                        yield m.finding(
+                            "JX012", node,
+                            f"leaf key `{k}` is read from an engine output "
+                            f"dict here but no engine finalize/aux path "
+                            f"produces it — renamed counter or dead consumer",
+                        )
+
+    # -- JX013 -------------------------------------------------------------
+
+    def _declared_flags(self) -> set[str]:
+        flags: set[str] = set()
+        files: list[str] = []
+        for entry in self.config.cli_modules:
+            if any(ch in entry for ch in "*?["):
+                for p in sorted(self.root.glob(entry)):
+                    files.append(p.relative_to(self.root).as_posix())
+            else:
+                files.append(entry)
+        for rel in files:
+            m = self._load(rel)
+            if m is None:
+                continue
+            for node in ast.walk(m.tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and _attr_leaf(node.func) == "add_argument"
+                ):
+                    for a in node.args:
+                        s = _const_str(a)
+                        if s is not None and s.startswith("--"):
+                            flags.add(s)
+        return flags
+
+    def check_cli_flags(self) -> Iterator[Finding]:
+        declared = self._declared_flags()
+        if not declared:
+            yield Finding(
+                "JX013", self.config.cli_modules[0] if self.config.cli_modules
+                else "pyproject.toml", 1, 0,
+                "no declared CLI flags found in the configured cli-modules — "
+                "the docs-drift check has nothing to compare (config drift)",
+            )
+            return
+        ignore = set(self.config.flag_ignore)
+        flag_re = re.compile(r"(?<![\w/=-])(--[a-z][a-z0-9-]*)")
+        for doc in self.config.doc_files:
+            lines = self._doc_lines(doc)
+            for i, line in enumerate(lines, start=1):
+                for mflag in flag_re.finditer(line):
+                    flag = mflag.group(1)
+                    if flag in declared or flag in ignore:
+                        continue
+                    yield self._doc_finding(
+                        "JX013", doc, i,
+                        f"documented flag `{flag}` is declared by no "
+                        f"argparse parser in the cli-modules — docs drift "
+                        f"(or add it to the flag-ignore config for an "
+                        f"external tool's flag)",
+                        lines, col=mflag.start(1),
+                    )
+
+
+# ---------------------------------------------------------------------------
+# Registry + entry point (mirrors rules.ALL_RULES for the project scope).
+
+ContractFn = Callable[[ProjectContracts], Iterator[Finding]]
+
+CONTRACT_RULES: dict[str, tuple[ContractFn, str]] = {
+    "JX010": (
+        ProjectContracts.check_telemetry,
+        "telemetry span/attr consumed but never emitted; schema-v2 row "
+        "contract; raw attr subscripts in dashboards",
+    ),
+    "JX011": (
+        ProjectContracts.check_chaos_seams,
+        "chaos seam fired/documented/drilled sets disagree",
+    ),
+    "JX012": (
+        ProjectContracts.check_finalize_leaves,
+        "finalize leaf outside the combine_sums/strip-list naming contract",
+    ),
+    "JX013": (
+        ProjectContracts.check_cli_flags,
+        "README-documented CLI flag no parser declares (docs drift)",
+    ),
+}
+
+
+def lint_contracts(
+    root: Path,
+    config: LintConfig | None = None,
+    rules=None,
+) -> list[Finding]:
+    """Run the cross-module contract rules over the project at ``root``.
+    ``rules`` filters to a subset of CONTRACT_RULES ids; Python findings
+    honor in-file suppression comments, doc/drill findings are baseline-only."""
+    config = config or LintConfig()
+    enabled = [
+        r.upper() for r in (rules if rules is not None else config.enabled_rules)
+    ]
+    wanted = [r for r in enabled if r in CONTRACT_RULES]
+    if not wanted:
+        return []
+    ctx = ProjectContracts(Path(root), config)
+    findings: list[Finding] = []
+    # The message is part of the dedup key: one node can carry two DISTINCT
+    # JX010 defects (a never-emitted key read through a raw subscript), and
+    # collapsing them would silently drop a diagnostic.
+    seen: set[tuple[str, str, int, int, str]] = set()
+    for rule_id in wanted:
+        fn, _ = CONTRACT_RULES[rule_id]
+        for f in fn(ctx):
+            key = (f.rule, f.path, f.line, f.col, f.message)
+            if key in seen:
+                continue
+            m = ctx.modules.get(f.path)
+            if m is not None and m.suppressions.is_suppressed(f.rule, f.line):
+                continue
+            seen.add(key)
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

@@ -126,7 +126,7 @@ def _phase_rows(spans: list[dict]) -> list[tuple[str, int, float]]:
     totals: dict[str, float] = defaultdict(float)
     counts: dict[str, int] = defaultdict(int)
     for sp in spans:
-        totals[sp["span"]] += float(sp.get("dur_s", 0.0))
+        totals[sp["span"]] += float(sp.get("dur_s") or 0.0)
         counts[sp["span"]] += 1
     return sorted(
         ((name, counts[name], totals[name]) for name in totals),
@@ -161,16 +161,21 @@ def _batch_aggregates(batches: list[dict]) -> dict[str, Any] | None:
     seen = False
     for sp in batches:
         attrs = sp.get("attrs") or {}
-        if "reorg_depth_max" in attrs:
+        if attrs.get("reorg_depth_max") is not None:
             seen = True
-            agg["reorg_depth_max"] = max(agg["reorg_depth_max"], int(attrs["reorg_depth_max"]))
-            agg["stale_events"] += int(attrs.get("stale_events", 0))
-            agg["active_steps"] += int(attrs.get("active_steps", 0))
-            agg["step_slots"] += int(attrs.get("step_slots", 0))
+            agg["reorg_depth_max"] = max(
+                agg["reorg_depth_max"], int(attrs.get("reorg_depth_max") or 0)
+            )
+            agg["stale_events"] += int(attrs.get("stale_events") or 0)
+            agg["active_steps"] += int(attrs.get("active_steps") or 0)
+            agg["step_slots"] += int(attrs.get("step_slots") or 0)
             for name in ("stale_by_miner", "reorg_depth_hist"):
-                if isinstance(attrs.get(name), list):
-                    agg[name] = _sum_vectors(agg[name], attrs[name])
-        agg["retries"] += int(attrs.get("retries", 0))
+                vec = attrs.get(name)
+                if isinstance(vec, list):
+                    agg[name] = _sum_vectors(agg[name], vec)
+        # `or 0`, not a .get default: a foreign ledger can carry the KEY with
+        # a null value, and int(None) would crash the dashboard.
+        agg["retries"] += int(attrs.get("retries") or 0)
     if not seen:
         return None
     agg["occupancy"] = (
@@ -202,9 +207,11 @@ def render_report(spans: list[dict], fmt: str = "text") -> str:
     if not spans:
         return "telemetry ledger is empty (no parseable spans)\n"
 
-    run_ids = sorted({sp.get("run_id", "?") for sp in spans})
-    t0 = min(sp.get("t_start", 0.0) for sp in spans)
-    t1 = max(sp.get("t_start", 0.0) + sp.get("dur_s", 0.0) for sp in spans)
+    # str-normalized: a foreign row with "run_id": null must not poison the
+    # sort (None vs str comparison) — same null class as the attr guards.
+    run_ids = sorted({str(sp.get("run_id") or "?") for sp in spans})
+    t0 = min((sp.get("t_start") or 0.0) for sp in spans)
+    t1 = max((sp.get("t_start") or 0.0) + (sp.get("dur_s") or 0.0) for sp in spans)
     title = "tpusim telemetry report"
     out.append(f"# {title}" if md else title)
     out.append(
@@ -254,17 +261,18 @@ def render_report(spans: list[dict], fmt: str = "text") -> str:
             )
             records = [
                 BatchRecord(
-                    int((sp.get("attrs") or {}).get("runs", 0)),
-                    float(sp.get("dur_s", 0.0)),
+                    int((sp.get("attrs") or {}).get("runs") or 0),
+                    float(sp.get("dur_s") or 0.0),
                 )
                 for sp in group
             ]
             a = run_attrs.get(key, {})
             # duration_ms/block_interval_s ride on the run span; without one
             # (partial ledger) only run-rate is derivable.
-            if "duration_ms" in a:
+            if a.get("duration_ms") is not None:
                 rep = throughput_report(
-                    records, int(a["duration_ms"]), float(a["block_interval_s"])
+                    records, int(a.get("duration_ms") or 0),
+                    float(a.get("block_interval_s") or 600.0),
                 )
             else:
                 rep = throughput_report(records, 0, 600.0)
@@ -284,9 +292,9 @@ def render_report(spans: list[dict], fmt: str = "text") -> str:
                 )
 
         stalls = [
-            float(sp["attrs"]["stall_s"])
+            float((sp.get("attrs") or {}).get("stall_s") or 0.0)
             for sp in batches
-            if "stall_s" in (sp.get("attrs") or {})
+            if (sp.get("attrs") or {}).get("stall_s") is not None
         ]
         heading("Pipelined-dispatch stall histogram")
         if stalls:
@@ -348,7 +356,7 @@ def render_report(spans: list[dict], fmt: str = "text") -> str:
         # a sweep whose grid points recompile shows up HERE, not only in a
         # test someone remembers to run.
         heading("XLA compiles & engine cache")
-        durs = [float(sp.get("dur_s", 0.0)) for sp in compiles]
+        durs = [float(sp.get("dur_s") or 0.0) for sp in compiles]
         rows = [
             ["backend compiles", str(len(compiles))],
             ["compile time (monitored events)", _fmt_s(sum(durs))],
@@ -370,7 +378,7 @@ def render_report(spans: list[dict], fmt: str = "text") -> str:
             by_ctx[
                 (str(attrs.get("engine", "?")),
                  str(attrs.get("dispatch", "build")))
-            ].append(float(sp.get("dur_s", 0.0)))
+            ].append(float(sp.get("dur_s") or 0.0))
         if by_ctx:
             table(
                 ["engine", "dispatch context", "compiles", "total"],
@@ -393,21 +401,26 @@ def render_report(spans: list[dict], fmt: str = "text") -> str:
         heading("Device memory (batch watermarks)")
         rows = [
             ["live-buffer watermark (jax.live_arrays)",
-             format_bytes(max(a["mem_live_bytes"] for a in mem_attrs))],
+             format_bytes(max(a.get("mem_live_bytes") or 0 for a in mem_attrs))],
             ["live buffers (max)",
-             str(max(int(a.get("mem_live_buffers", 0)) for a in mem_attrs))],
+             str(max(int(a.get("mem_live_buffers") or 0) for a in mem_attrs))],
         ]
-        peaks = [a["mem_peak_bytes"] for a in mem_attrs if "mem_peak_bytes" in a]
+        peaks = [
+            a.get("mem_peak_bytes") for a in mem_attrs
+            if a.get("mem_peak_bytes") is not None
+        ]
         if peaks:
             rows.append(["allocator peak (memory_stats)", format_bytes(max(peaks))])
         last = mem_attrs[-1]
-        if "state_bytes_per_run" in last:
+        state_bytes = last.get("state_bytes_per_run")
+        if state_bytes is not None:
             rows.append(
                 ["state bytes per run (dtype-resolved)",
-                 format_bytes(last["state_bytes_per_run"])]
+                 format_bytes(state_bytes)]
             )
-        if "vmem_est_bytes" in last:
-            est, budget = last["vmem_est_bytes"], last.get("vmem_budget_bytes")
+        est = last.get("vmem_est_bytes")
+        if est is not None:
+            budget = last.get("vmem_budget_bytes")
             val = format_bytes(est)
             if budget:
                 val += f" of {format_bytes(budget)} budget ({100 * est / budget:.0f}%)"
@@ -457,11 +470,11 @@ def render_report(spans: list[dict], fmt: str = "text") -> str:
             )
             line = f"{a.get('runs', '?')} runs folded"
             if a.get("runs_done") is not None and a.get("runs_done") != a.get("runs"):
-                line += f" (run at {a['runs_done']} incl. resumed checkpoint)"
+                line += f" (run at {a.get('runs_done')} incl. resumed checkpoint)"
             if a.get("runs_total"):
-                line += f" of {a['runs_total']} planned"
+                line += f" of {a.get('runs_total')} planned"
             if a.get("target_rel_hw") is not None:
-                line += f"; target rel half-width {format_num(a['target_rel_hw'])}"
+                line += f"; target rel half-width {format_num(a.get('target_rel_hw'))}"
             if a.get("rate_is_first_batch"):
                 line += "; ETA rate from the compile-contaminated first batch"
             out.append("  " + line)
@@ -586,7 +599,7 @@ def render_report(spans: list[dict], fmt: str = "text") -> str:
             [
                 [str((sp.get("attrs") or {}).get("point", "?")),
                  str((sp.get("attrs") or {}).get("runs", "?")),
-                 _fmt_s(float(sp.get("dur_s", 0.0)))]
+                 _fmt_s(float(sp.get("dur_s") or 0.0))]
                 for sp in points
             ],
         )

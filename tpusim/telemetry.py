@@ -396,7 +396,11 @@ def load_spans(path: str | Path) -> list[dict]:
             row = json.loads(line)
         except json.JSONDecodeError:
             continue
-        if isinstance(row, dict) and "span" in row:
+        # A string span name is the one row field every consumer keys on;
+        # a foreign row carrying "span": null (or a number) is not a span
+        # and would crash the dashboards' grouping, so it is filtered here
+        # like any other non-span line.
+        if isinstance(row, dict) and isinstance(row.get("span"), str):
             spans.append(row)
     return spans
 

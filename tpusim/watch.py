@@ -51,11 +51,13 @@ def render_watch(spans: list[dict], source: str, now: float | None = None) -> st
         out.append("  (no parseable spans yet — waiting for the run to emit)")
         return "\n".join(out) + "\n"
 
-    run_ids = [sp.get("run_id", "?") for sp in spans]
+    # str-normalized like the report's grouping: "run_id": null must neither
+    # crash the set count nor split the panels from their own run.
+    run_ids = [str(sp.get("run_id") or "?") for sp in spans]
     rid = run_ids[-1]
-    mine = [sp for sp in spans if sp.get("run_id", "?") == rid]
+    mine = [sp for sp in spans if str(sp.get("run_id") or "?") == rid]
     n_other = len(set(run_ids)) - 1
-    last_t = max(sp.get("t_start", 0.0) + sp.get("dur_s", 0.0) for sp in mine)
+    last_t = max((sp.get("t_start") or 0.0) + (sp.get("dur_s") or 0.0) for sp in mine)
     completed = any(sp["span"] == "run" for sp in mine)
     head = (
         f"run_id {rid}"
@@ -75,7 +77,7 @@ def render_watch(spans: list[dict], source: str, now: float | None = None) -> st
     runs_done = last_stats.get("runs_done", last_stats.get("runs"))
     runs_total = last_stats.get("runs_total")
     if runs_done is None and batches:
-        runs_done = sum(int((sp.get("attrs") or {}).get("runs", 0)) for sp in batches)
+        runs_done = sum(int((sp.get("attrs") or {}).get("runs") or 0) for sp in batches)
     if runs_done is not None:
         line = f"runs {runs_done}"
         if runs_total:
@@ -87,17 +89,17 @@ def render_watch(spans: list[dict], source: str, now: float | None = None) -> st
     if batches:
         records = [
             BatchRecord(
-                int((sp.get("attrs") or {}).get("runs", 0)),
-                float(sp.get("dur_s", 0.0)),
+                int((sp.get("attrs") or {}).get("runs") or 0),
+                float(sp.get("dur_s") or 0.0),
             )
             for sp in batches
         ]
         # duration_ms rides every stats span, so sim-rate is derivable
         # mid-run; a foreign ledger without one still gets run-rate.
-        if "duration_ms" in last_stats:
+        if last_stats.get("duration_ms") is not None:
             rep = throughput_report(
-                records, int(last_stats["duration_ms"]),
-                float(last_stats.get("block_interval_s", 600.0)),
+                records, int(last_stats.get("duration_ms") or 0),
+                float(last_stats.get("block_interval_s") or 600.0),
             )
         else:
             rep = throughput_report(records, 0, 600.0)
@@ -116,9 +118,9 @@ def render_watch(spans: list[dict], source: str, now: float | None = None) -> st
             # batch off as steady state without saying so.
             line += " · SINGLE BATCH — compile-contaminated estimate"
         out.append(line)
-        active = sum(int((sp.get("attrs") or {}).get("active_steps", 0)) for sp in batches)
-        slots = sum(int((sp.get("attrs") or {}).get("step_slots", 0)) for sp in batches)
-        retries = sum(int((sp.get("attrs") or {}).get("retries", 0)) for sp in batches)
+        active = sum(int((sp.get("attrs") or {}).get("active_steps") or 0) for sp in batches)
+        slots = sum(int((sp.get("attrs") or {}).get("step_slots") or 0) for sp in batches)
+        retries = sum(int((sp.get("attrs") or {}).get("retries") or 0) for sp in batches)
         occ = f"{active / slots:.3f}" if slots else "n/a"
         out.append(f"occupancy {occ} · retries {retries}")
 
@@ -135,19 +137,18 @@ def render_watch(spans: list[dict], source: str, now: float | None = None) -> st
     if compiles or cache_sp or mem:
         parts = []
         if compiles:
-            total = sum(float(sp.get("dur_s", 0.0)) for sp in compiles)
+            total = sum(float(sp.get("dur_s") or 0.0) for sp in compiles)
             parts.append(f"compiles {len(compiles)} ({total:.2f} s)")
         if cache_sp:
             hits = sum(1 for sp in cache_sp if (sp.get("attrs") or {}).get("hit"))
             parts.append(f"engine cache {hits}/{len(cache_sp)} hit")
         if mem:
-            watermark = max(a["mem_live_bytes"] for a in mem)
+            watermark = max(a.get("mem_live_bytes") or 0 for a in mem)
             parts.append(f"live buffers {format_bytes(watermark)}")
             last = mem[-1]
-            if "vmem_est_bytes" in last and last.get("vmem_budget_bytes"):
-                parts.append(
-                    f"VMEM est {100 * last['vmem_est_bytes'] / last['vmem_budget_bytes']:.0f}% of budget"
-                )
+            est, budget = last.get("vmem_est_bytes"), last.get("vmem_budget_bytes")
+            if est is not None and budget:
+                parts.append(f"VMEM est {100 * est / budget:.0f}% of budget")
         out.append(" · ".join(parts))
 
     # --- Fleet supervisor (tpusim.fleet): the elastic-sweep live state —
