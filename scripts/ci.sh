@@ -192,7 +192,12 @@ echo "== packed-sweep leg (grid packing bit-equality) =="
 # diffed LINE-FOR-LINE minus the wall-clock fields (elapsed_s/compile_s —
 # the fleet-leg strip), and the packed per-point convergence panel rendered
 # by BOTH dashboards. The points/sec perf gate for packing rides the
-# perf-observability leg above.
+# perf-observability leg above. The leg runs ARMED: the packed pass writes
+# per-point piece checkpoints (--checkpoint-dir no longer disables packing)
+# and the grid repeats under rng=xoroshiro (per-run stream seeds pack too) —
+# both formerly fallback carve-outs, now diffed bit-for-bit against the
+# sequential path. A resumed packed pass over the finished checkpoint dir
+# must reproduce the same rows without recomputing.
 packed_dir="$tele_dir/packed"
 mkdir -p "$packed_dir"
 env JAX_PLATFORMS=cpu python - "$packed_dir" <<'EOF'
@@ -202,20 +207,35 @@ from tpusim.config import NetworkConfig, SimConfig
 from tpusim.sweep import _selfish_network, run_sweep
 
 out = Path(sys.argv[1])
-pts = []
-for interval_s in (300.0, 600.0):
-    for pct in (30, 40):
-        net = _selfish_network(pct)
-        net = NetworkConfig(miners=net.miners, block_interval_s=interval_s)
-        pts.append((f"i{int(interval_s)}-s{pct}",
-                    SimConfig(network=net, runs=8, duration_ms=86_400_000,
-                              batch_size=8)))
+
+def grid(rng):
+    pts = []
+    for interval_s in (300.0, 600.0):
+        for pct in (30, 40):
+            net = _selfish_network(pct)
+            net = NetworkConfig(miners=net.miners, block_interval_s=interval_s)
+            pts.append((f"i{int(interval_s)}-s{pct}",
+                        SimConfig(network=net, runs=8, duration_ms=86_400_000,
+                                  batch_size=8, rng=rng)))
+    return pts
+
 cache: dict = {}
-run_sweep(pts, quiet=True, engine_cache=cache, out_path=out / "seq.jsonl")
-run_sweep(pts, quiet=True, engine_cache=cache, packed=True,
+run_sweep(grid("threefry"), quiet=True, engine_cache=cache,
+          out_path=out / "seq.jsonl")
+run_sweep(grid("threefry"), quiet=True, engine_cache=cache, packed=True,
           out_path=out / "packed.jsonl",
-          telemetry_path=out / "packed.tele.jsonl")
-for name in ("seq", "packed"):
+          telemetry_path=out / "packed.tele.jsonl",
+          checkpoint_dir=out / "ckpt")
+assert sorted(p.name for p in (out / "ckpt").glob("*.npz")), "no piece ckpts"
+# Resume over the complete checkpoint dir: zero new dispatches, same rows.
+run_sweep(grid("threefry"), quiet=True, engine_cache=cache, packed=True,
+          out_path=out / "packed_resume.jsonl", checkpoint_dir=out / "ckpt")
+# The xoroshiro carve-out is gone: per-run stream seeds pack bit-for-bit.
+run_sweep(grid("xoroshiro"), quiet=True, engine_cache=cache,
+          out_path=out / "seq_xoro.jsonl")
+run_sweep(grid("xoroshiro"), quiet=True, engine_cache=cache, packed=True,
+          out_path=out / "packed_xoro.jsonl")
+for name in ("seq", "packed", "packed_resume", "seq_xoro", "packed_xoro"):
     rows = [json.loads(ln) for ln in (out / f"{name}.jsonl").open()]
     for r in rows:
         r.pop("elapsed_s", None); r.pop("compile_s", None)
@@ -223,6 +243,8 @@ for name in ("seq", "packed"):
         "\n".join(json.dumps(r) for r in rows) + "\n")
 EOF
 diff "$packed_dir/seq.stripped" "$packed_dir/packed.stripped"
+diff "$packed_dir/seq.stripped" "$packed_dir/packed_resume.stripped"
+diff "$packed_dir/seq_xoro.stripped" "$packed_dir/packed_xoro.stripped"
 python -m tpusim watch --once "$packed_dir/packed.tele.jsonl" \
   | grep -q "by grid point"
 env JAX_PLATFORMS=cpu python -m tpusim report "$packed_dir/packed.tele.jsonl" \
@@ -231,13 +253,17 @@ echo "packed sweep: rows line-identical + per-point panels rendered"
 
 echo "== fleet kill-drill smoke =="
 # The elastic-fleet healing contract end to end (tpusim.fleet): two
-# supervisor runs over the same 2-point grid — one clean, one with the
-# COMMITTED worker-kill drill plan (drills/fleet-worker-kill.json: SIGKILL
-# the attempt-0 worker right after its first checkpoint turns durable) —
-# must produce IDENTICAL rows minus wall-clock, the supervisor must requeue
-# exactly once and quarantine nothing, `tpusim watch` (started BEFORE the
-# ledger exists, via --wait-for-file) must follow the drill live and exit on
-# the closing span, and `tpusim report` must render the fleet panel.
+# supervisor runs over the same 2-point grid — one clean and sequential, one
+# PACKED (both points as one sub-grid unit) with the COMMITTED worker-kill
+# drill plan (drills/fleet-worker-kill.json: SIGKILL the attempt-0 worker
+# right after its first piece checkpoint turns durable) — must produce
+# IDENTICAL rows minus wall-clock (cross-path: drilled packed == clean
+# sequential), the supervisor must requeue exactly once and quarantine
+# nothing, the replacement worker must heal MID-PACK via the shared piece
+# checkpoints (a `checkpoint_load` span with packed=true in the ledger),
+# `tpusim watch` (started BEFORE the ledger exists, via --wait-for-file)
+# must follow the drill live and exit on the closing span, and
+# `tpusim report` must render the fleet panel.
 fleet_dir="$tele_dir/fleet"
 mkdir -p "$fleet_dir"
 # The drill supervisor's ledger lives INSIDE its state dir so the
@@ -253,6 +279,7 @@ env JAX_PLATFORMS=cpu python -m tpusim.cli fleet propagation --max-points 2 \
   --runs-scale 3e-6 --batch-size 2 --workers 2 --single-device --no-probe \
   --quiet --state-dir "$fleet_dir/drill" --lease-s 120 \
   --telemetry "$fleet_dir/drill/fleet.tele.jsonl" \
+  --packed --grid-size 2 \
   --worker-chaos drills/fleet-worker-kill.json --worker-chaos-point prop-100ms
 wait "$watch_pid"
 grep -q "fleet:" "$fleet_dir/watch.txt"
@@ -271,6 +298,23 @@ assert ref == drill, "drilled fleet rows diverged from the uninterrupted run"
 events = [json.loads(ln)["event"] for ln in open(sys.argv[3]) if ln.strip()]
 assert events.count("requeue") == 1 and events.count("quarantine") == 0, events
 print(f"fleet kill drill: {len(drill)} rows bit-equal after 1 requeue")
+EOF
+# The healed sub-grid must have resumed MID-PACK from the shared piece
+# checkpoints, not recomputed from scratch: the replacement worker's own
+# ledger (state-dir/workers/*.tele.jsonl — the files `trace timeline`
+# merges) carries a packed checkpoint_load span.
+python - "$fleet_dir/drill" <<'EOF'
+import json, sys
+from pathlib import Path
+loads = [
+    row
+    for path in sorted(Path(sys.argv[1], "workers").glob("*.tele.jsonl"))
+    for row in map(json.loads, path.open())
+    if row.get("span") == "checkpoint_load"
+    and (row.get("attrs") or {}).get("packed")
+]
+assert loads, "no packed checkpoint_load span: the healed sub-grid recomputed"
+print(f"fleet kill drill: healed mid-pack ({len(loads)} piece-checkpoint loads)")
 EOF
 env JAX_PLATFORMS=cpu python -m tpusim report "$fleet_dir/drill/fleet.tele.jsonl" \
   | grep -q "Fleet (worker supervisor)"

@@ -1,0 +1,49 @@
+"""Subprocess target for the packed mid-pack SIGKILL drill
+(tests/test_packed_sweep.py).
+
+Runs the test module's reference selfish-threshold grid PACKED with
+per-point piece checkpoints and a chaos plan that SIGKILLs this process at
+``post_replace`` of the FIRST checkpoint save — i.e. right after one
+point's partial run cursor turns durable and before any other point saves —
+so the parent test can resume the pack from whatever the kill left on disk
+and pin the healed rows bit-equal to an uninterrupted sequential sweep.
+SIGKILL is unmaskable: if this script prints UNREACHABLE, the injection did
+not fire and the test must fail.
+
+argv: [checkpoint_dir]. The parent sets JAX_PLATFORMS=cpu and clears the
+tunnel trigger env.
+"""
+
+import sys
+
+
+def main() -> None:
+    from tpusim.chaos import ChaosInjector, ChaosPlan, FaultSpec
+    from tpusim.config import NetworkConfig, SimConfig
+    from tpusim.sweep import _selfish_network, run_sweep
+
+    # The exact _grid() of tests/test_packed_sweep.py (runs=12, batch=8:
+    # two pieces per point, so the first save is genuinely mid-pack).
+    pts = []
+    for interval_s in (300.0, 600.0):
+        for pct in (30, 40):
+            net = _selfish_network(pct)
+            net = NetworkConfig(miners=net.miners, block_interval_s=interval_s)
+            pts.append((
+                f"i{int(interval_s)}-s{pct}",
+                SimConfig(network=net, runs=12, duration_ms=86_400_000,
+                          batch_size=8),
+            ))
+    plan = ChaosPlan(faults=[
+        FaultSpec(point="checkpoint.save", kind="sigkill", count=1,
+                  when={"phase": "post_replace"}),
+    ])
+    run_sweep(
+        pts, quiet=True, packed=True, engine_cache={},
+        checkpoint_dir=sys.argv[1], chaos=ChaosInjector(plan),
+    )
+    print("UNREACHABLE: sigkill fault never fired")
+
+
+if __name__ == "__main__":
+    main()

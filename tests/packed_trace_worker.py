@@ -1,0 +1,40 @@
+"""Subprocess target for the packed cross-backend trace test
+(tests/test_trace_diff.py).
+
+Runs the named xoroshiro flight configs as ONE packed grid — pack_width
+spanning every run so the points share a single packed dispatch — and
+writes each point's pack-decoded event log with the byte-stable
+``events_jsonl`` writer. The parent diffs each file against the native
+producer's log for the same config; launched in a JAX_ENABLE_X64
+subprocess because the xoroshiro interval mapping is bit-exact to the
+native backend only in float64.
+
+argv: [out_dir, name1, config_json1, name2, config_json2, ...].
+"""
+
+import sys
+from pathlib import Path
+
+
+def main() -> None:
+    from tpusim.config import SimConfig
+    from tpusim.flight_export import events_jsonl
+    from tpusim.packed import run_grid
+
+    out = Path(sys.argv[1])
+    points = [
+        (sys.argv[i], SimConfig.from_json(sys.argv[i + 1]))
+        for i in range(2, len(sys.argv), 2)
+    ]
+    entries = run_grid(
+        points, engine_cache={},
+        pack_width=sum(c.runs for _, c in points),
+    )
+    for entry in entries:
+        (out / f"{entry['name']}.events.jsonl").write_text(
+            events_jsonl(entry["flight"].events)
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -988,6 +988,8 @@ def _contract_cfg(**over):
         leaf_strip_prefixes=("tele_",),
         leaf_merge_suffixes=("_sum", "_max", "_per_run"),
         leaf_scalar_allowlist=("runs",),
+        packed_consumer_modules=("orc.py",),
+        packed_leaf_strip=(),
         cli_modules=("cli_mod.py",),
         flag_ignore=(),
     )
@@ -1196,6 +1198,10 @@ def drive(raw):
     for k in list(raw):
         if k.startswith("tele_"):
             raw.pop(k)
+
+
+def fold_piece(raw, start, count):
+    return raw["share_per_run"][start:start + count]
 """
 
 
@@ -1236,6 +1242,34 @@ def test_jx012_merge_rule_and_strip_list_drift(tmp_path):
     )
     findings = lint_contracts(tmp_path, cfg, rules=["JX012"])
     assert any("strips" in f.message and "tele_" in f.message for f in findings)
+
+
+def test_jx012_packed_leaf_piece_boundary_fate(tmp_path):
+    """Sub-check (5): every `*_per_run` / `flight_*` leaf an engine stores
+    must be read by constant name in a packed-consumer module, or be listed
+    in packed-leaf-strip as intentionally dropped at piece boundaries."""
+    (tmp_path / "eng.py").write_text(_ENG_OK)
+    (tmp_path / "orc.py").write_text(_ORC_OK)
+    cfg = _write_contract_proj(tmp_path)
+    assert lint_contracts(tmp_path, cfg, rules=["JX012"]) == []
+    # A packed leaf nothing slices fires (flight_* class too).
+    (tmp_path / "eng.py").write_text(
+        _ENG_OK + "\n\ndef aux(sums):\n    sums[\"flight_buf\"] = 1\n"
+    )
+    findings = lint_contracts(tmp_path, cfg, rules=["JX012"])
+    assert any("flight_buf" in f.message and "piece-boundary" in f.message
+               for f in findings)
+    # Declaring the drop in packed-leaf-strip clears it.
+    cfg = _write_contract_proj(tmp_path, packed_leaf_strip=("flight_buf",))
+    assert not any("piece-boundary" in f.message
+                   for f in lint_contracts(tmp_path, cfg, rules=["JX012"]))
+    # A constant-name read in the packed consumer clears it too.
+    cfg = _write_contract_proj(tmp_path)
+    (tmp_path / "orc.py").write_text(
+        _ORC_OK + "\n\ndef decode(sums):\n    sums[\"flight_buf\"]\n"
+    )
+    assert not any("piece-boundary" in f.message
+                   for f in lint_contracts(tmp_path, cfg, rules=["JX012"]))
 
 
 def test_jx013_doc_flag_drift_and_ignore(tmp_path):

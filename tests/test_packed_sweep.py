@@ -22,6 +22,11 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
+import signal
+import subprocess
+import sys
+from pathlib import Path
 
 import numpy as np
 import pytest
@@ -118,15 +123,22 @@ def test_planner_groups_same_shape_and_routes_fallbacks():
     # params).
     assert len(packs) == 2 and sequential == []
     assert [p.indices for p in packs] == [[0, 1], [2, 3]]
-    # xoroshiro and flight-recorder points take the sequential path.
+    # xoroshiro and flight-recorder points PACK (the former carve-outs are
+    # gone) — but rng and an armed recorder are program shape, so each forms
+    # its own shape group rather than riding the threefry pack.
     xoro = dataclasses.replace(pts[0][1], rng="xoroshiro")
     flight = dataclasses.replace(pts[1][1], flight_capacity=64)
-    assert not packable(xoro) and not packable(flight)
+    assert packable(xoro) and packable(flight)
     packs, sequential = plan_packs(
         [pts[0], ("x", xoro), ("f", flight), pts[1]]
     )
-    assert sequential == [1, 2]
-    assert [p.indices for p in packs] == [[0, 3]]
+    assert sequential == []
+    assert [p.indices for p in packs] == [[0, 3], [1], [2]]
+    assert pack_shape_key(xoro) != pack_shape_key(pts[0][1])
+    # Two same-shape xoroshiro points share one pack.
+    xoro2 = dataclasses.replace(pts[1][1], rng="xoroshiro")
+    packs, sequential = plan_packs([("x0", xoro), ("x1", xoro2)])
+    assert [p.indices for p in packs] == [[0, 1]] and sequential == []
     # A different miner count is a different program shape -> its own pack.
     other = SimConfig(network=default_network(), runs=8,
                       duration_ms=DAY, batch_size=8)
@@ -354,38 +366,152 @@ def test_pack_widens_mixed_dtype_grid_and_stays_bit_equal():
 
 def test_packed_engine_validation():
     cfg = _grid()[0][1]
-    with pytest.raises(ValueError, match="xoroshiro"):
-        Engine(dataclasses.replace(cfg, rng="xoroshiro"), packed=True)
+    # The xoroshiro carve-out is GONE: a packed xoroshiro engine builds.
+    Engine(dataclasses.replace(cfg, rng="xoroshiro"), packed=True)
     with pytest.raises(ValueError, match="tpu backend"):
         run_sweep(_grid(), backend="cpp", packed=True, quiet=True)
 
 
-def test_checkpoint_dir_falls_back_sequential(tmp_path, caplog):
-    """Packing has no per-point checkpoints: --checkpoint-dir disables it
-    with a warning, and the rows still land (sequential path)."""
-    pts = _grid()[:1]
+def test_checkpoint_dir_packs_with_piece_checkpoints(tmp_path, caplog, seq_rows):
+    """--checkpoint-dir no longer disables packing: the packed path writes
+    the sequential runner's own fingerprinted per-point npz after every
+    dispatch, rows stay bit-equal to the sequential sweep, and a re-run over
+    the completed checkpoint dir reproduces the same rows from the saved
+    sums alone."""
+    pts = _grid()
+    ckdir = tmp_path / "ckpt"
     with caplog.at_level("WARNING", logger="tpusim"):
         rows = run_sweep(
             pts, quiet=True, packed=True, engine_cache=CACHE,
-            checkpoint_dir=tmp_path / "ckpt",
+            checkpoint_dir=ckdir,
         )
-    assert "falls back to the sequential path" in caplog.text
-    assert len(rows) == 1 and rows[0]["compile_s"] is not None
+    assert "falls back" not in caplog.text
+    assert _strip(rows) == _strip(seq_rows)
+    assert sorted(p.name for p in ckdir.glob("*.npz")) == sorted(
+        f"{name}.npz" for name, _ in pts
+    )
+    for name, cfg in pts:
+        with np.load(ckdir / f"{name}.npz") as saved:
+            assert int(saved["__runs_done__"]) == cfg.runs
+    resumed = run_sweep(
+        pts, quiet=True, packed=True, engine_cache=CACHE, checkpoint_dir=ckdir,
+    )
+    assert _strip(resumed) == _strip(seq_rows)
 
 
-def test_mixed_grid_falls_back_per_point_in_order(seq_rows):
-    """A grid mixing packable and xoroshiro points keeps the EXACT output
-    point order, with the fallback point's row equal to its own sequential
-    run."""
+def test_checkpoint_cross_path_resume_bit_equal(tmp_path, seq_rows):
+    """Packed piece checkpoints ARE sequential checkpoints: a sequential
+    sweep resumes what a packed sweep saved (and vice versa), bit-equal to
+    an uninterrupted run either way."""
+    pts = _grid()
+    packed_dir = tmp_path / "from-packed"
+    run_sweep(pts, quiet=True, packed=True, engine_cache=CACHE,
+              checkpoint_dir=packed_dir)
+    rows = run_sweep(pts, quiet=True, engine_cache=CACHE,
+                     checkpoint_dir=packed_dir)
+    assert _strip(rows) == _strip(seq_rows)
+    seq_dir = tmp_path / "from-seq"
+    run_sweep(pts, quiet=True, engine_cache=CACHE, checkpoint_dir=seq_dir)
+    rows = run_sweep(pts, quiet=True, packed=True, engine_cache=CACHE,
+                     checkpoint_dir=seq_dir)
+    assert _strip(rows) == _strip(seq_rows)
+
+
+def test_mixed_grid_packs_per_shape_group_in_order(seq_rows):
+    """A grid mixing threefry and xoroshiro points keeps the EXACT output
+    point order; the xoroshiro point packs in its own shape group with its
+    row equal to its own sequential run."""
     pts = _grid()
     xoro_cfg = dataclasses.replace(pts[1][1], rng="xoroshiro")
     mixed = [pts[0], ("xoro", xoro_cfg), pts[2]]
+    packs, sequential = plan_packs(mixed)
+    assert sequential == [] and len(packs) == 3
     rows = run_sweep(mixed, quiet=True, packed=True, engine_cache=CACHE)
     assert [r["point"] for r in rows] == [pts[0][0], "xoro", pts[2][0]]
     by_point = {r["point"]: r for r in _strip(rows)}
     want = {r["point"]: r for r in _strip(seq_rows)}
     assert by_point[pts[0][0]] == want[pts[0][0]]
     assert by_point[pts[2][0]] == want[pts[2][0]]
+    seq_xoro = run_sweep([("xoro", xoro_cfg)], quiet=True, engine_cache=CACHE)
+    assert by_point["xoro"] == _strip(seq_xoro)[0]
+
+
+def test_packed_xoroshiro_bit_equal_sequential():
+    """Per-run xoroshiro stream packing at the engine level: a whole
+    xoroshiro grid through run_grid lands every result field bit-equal to
+    the sequential sweep — the stacked (runs, 8) stream rows reproduce the
+    native backend's per-run (seed, run) derivation exactly, and the f64
+    mean-interval leaf keeps the interval mapping identical."""
+    pts = [(n, dataclasses.replace(c, rng="xoroshiro")) for n, c in _grid()]
+    seq = run_sweep(pts, quiet=True, engine_cache=CACHE)
+    entries = _run_grid_all(pts, engine_cache=CACHE)
+    for row, entry in zip(seq, entries):
+        got = entry["results"].to_dict()
+        for k, v in row.items():
+            if k not in _WALL and k not in ("point", "backend"):
+                assert got[k] == v, (entry["name"], k)
+
+
+def test_packed_sigkill_mid_pack_resume_bit_equal(tmp_path, seq_rows):
+    """The mid-pack durability drill: SIGKILL a packed sweep right after its
+    FIRST piece checkpoint turns durable (post_replace — one point saved
+    partway, the rest unsaved), then resume packed over the same checkpoint
+    dir; the healed rows must be bit-equal to an uninterrupted sequential
+    sweep."""
+    from tpusim.probe import TUNNEL_TRIGGER_ENV
+
+    ckdir = tmp_path / "ckpt"
+    repo = str(Path(__file__).parent.parent)
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop(TUNNEL_TRIGGER_ENV, None)
+    worker = Path(__file__).parent / "packed_kill_worker.py"
+    r = subprocess.run(
+        [sys.executable, str(worker), str(ckdir)],
+        capture_output=True, text=True, timeout=420, env=env, cwd=repo,
+    )
+    assert r.returncode == -signal.SIGKILL, (r.returncode, r.stdout, r.stderr)
+    assert "UNREACHABLE" not in r.stdout
+    # The kill landed MID-PACK: at least one durable piece checkpoint holds
+    # a partial run cursor.
+    done = {}
+    for p in sorted(ckdir.glob("*.npz")):
+        with np.load(p) as saved:
+            done[p.stem] = int(saved["__runs_done__"])
+    assert done and any(0 < v < 12 for v in done.values()), done
+    rows = run_sweep(
+        _grid(), quiet=True, packed=True, engine_cache=CACHE,
+        checkpoint_dir=ckdir,
+    )
+    assert _strip(rows) == _strip(seq_rows)
+
+
+def test_packed_flight_decode_run_id_round_trip():
+    """Pack-aware flight decode: the per-run event rings ride the pack's
+    runs axis and decode_flight_packed maps every pack position back to its
+    (point, run) — each point's packed event log is identical to its own
+    sequential batched decode, absolute run ids intact across pieces."""
+    from tpusim.flight_export import decode_flight
+
+    pts = [
+        ("f-a", SimConfig(network=default_network(propagation_ms=10_000),
+                          runs=8, batch_size=4, duration_ms=DAY,
+                          flight_capacity=512, seed=3)),
+        ("f-b", SimConfig(network=default_network(propagation_ms=1000),
+                          runs=8, batch_size=4, duration_ms=DAY,
+                          flight_capacity=512, seed=9)),
+    ]
+    entries = _run_grid_all(pts, engine_cache=CACHE)
+    for (name, cfg), entry in zip(pts, entries):
+        eng = Engine(cfg)
+        events: list[dict] = []
+        for start in range(0, cfg.runs, cfg.batch_size):
+            out = eng.run_batch(make_run_keys(cfg.seed, start, cfg.batch_size))
+            events.extend(decode_flight(out, start=start).events)
+        events.sort(key=lambda e: (e["run"], e["seq"]))
+        assert events and entry["flight"].events == events, name
+        assert {e["run"] for e in entry["flight"].events} <= set(range(cfg.runs))
 
 
 # ---------------------------------------------------------------------------

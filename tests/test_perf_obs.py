@@ -432,7 +432,9 @@ def test_perf_run_engine_pin_excludes_sweep_scenario(tmp_path, monkeypatch, caps
     assert ei.value.code == 2
     calls.clear()
     assert perf.main(["run", "--quick", "--out", str(out)]) == 0
-    assert [c[0] for c in calls] == ["chained", "sweep"]
+    # The default set runs the base sweep pair plus the ckpt/xoro variants.
+    assert [c[0] for c in calls] == ["chained", "sweep", "sweep", "sweep"]
+    assert [c[1].get("variant") for c in calls[1:]] == [None, "ckpt", "xoro"]
 
 
 def test_committed_calibration_baseline_is_valid():
@@ -456,6 +458,14 @@ def test_committed_calibration_baseline_is_valid():
     # stays anchored, and both must be at the quick sweep shape.
     assert ("sweep_sequential", "points_per_s") in latest
     assert ("sweep_packed", "points_per_s") in latest
+    # The PR-16 variant rows gate the retired carve-outs: a checkpointed
+    # packed grid and a per-run-xoroshiro packed grid each keep their own
+    # calibration row so a regression back to the sequential fallback
+    # (a ~2x slowdown at this shape) reddens `perf compare`.
+    ck = latest[("sweep_packed_ckpt", "points_per_s")]
+    assert ck["shape"]["checkpointed"] and ck["shape"]["rng"] == "threefry"
+    xo = latest[("sweep_packed_xoro", "points_per_s")]
+    assert not xo["shape"]["checkpointed"] and xo["shape"]["rng"] == "xoroshiro"
     sweep_quick = perf.SWEEP_PROTOCOL["quick"]
     n_points = len(sweep_quick["intervals"]) * len(sweep_quick["pcts"])
     for row in latest.values():
@@ -464,7 +474,7 @@ def test_committed_calibration_baseline_is_valid():
             assert row["better"] == "higher"
             assert row["shape"]["points"] == n_points
             assert row["shape"]["runs_per_point"] == sweep_quick["runs"]
-            assert row["shape"]["packed"] == (row["scenario"] == "sweep_packed")
+            assert row["shape"]["packed"] == row["scenario"].startswith("sweep_packed")
             assert len(row["samples"]) == sweep_quick["repeats"]
         else:
             assert row["shape"]["runs"] == perf.PROTOCOL["quick"]["runs"]

@@ -183,6 +183,59 @@ def test_native_matches_jax_flight_recorder(tmp_path):
     assert jax_log.read_text() == native.read_text()
 
 
+def test_packed_trace_diff_native_byte_identical(tmp_path):
+    """The packed twin of the recipe above: a 2-point xoroshiro flight grid
+    run as ONE packed dispatch (pack_width spans both points) must decode,
+    per point, an event log BYTE-identical to the native producer's for that
+    point's own (seed, run) universe — the pack-position -> (point, run)
+    mapping is exact."""
+    import dataclasses
+
+    from tpusim.backend.cpp import run_events_cpp
+    from tpusim.config import MinerConfig
+    from tpusim.probe import TUNNEL_TRIGGER_ENV
+
+    other = dataclasses.replace(
+        TINY, seed=7,
+        network=NetworkConfig(
+            miners=(
+                MinerConfig(hashrate_pct=50, propagation_ms=1000),
+                MinerConfig(hashrate_pct=30, propagation_ms=500),
+                MinerConfig(hashrate_pct=20, propagation_ms=0),
+            )
+        ),
+    )
+    cfgs = [
+        (name, dataclasses.replace(c, flight_capacity=4096))
+        for name, c in (("tiny", TINY), ("other", other))
+    ]
+
+    env = os.environ.copy()
+    env.pop(TUNNEL_TRIGGER_ENV, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_ENABLE_X64"] = "1"
+    repo = str(Path(__file__).parent.parent)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    worker = Path(__file__).parent / "packed_trace_worker.py"
+    argv = [sys.executable, str(worker), str(tmp_path)]
+    for name, c in cfgs:
+        argv += [name, c.to_json()]
+    proc = subprocess.run(
+        argv, capture_output=True, text=True, env=env, timeout=600, cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+    for name, c in cfgs:
+        native = tmp_path / f"{name}.native.jsonl"
+        run_events_cpp(dataclasses.replace(c, flight_capacity=0), native)
+        packed_log = tmp_path / f"{name}.events.jsonl"
+        d = diff_event_logs(
+            load_events_jsonl(packed_log), load_events_jsonl(native)
+        )
+        assert not d.divergent, d.render(f"packed:{name}", "native")
+        assert packed_log.read_text() == native.read_text(), name
+
+
 def test_cpp_backend_trace_cli_surface(tmp_path, capsys):
     from tpusim.flight_export import main as trace_main
 

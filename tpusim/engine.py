@@ -461,21 +461,19 @@ class Engine:
         point. The per-run compute is identical — vmap slices each run the
         same params it would have received broadcast — so results are
         bit-equal to a sequential per-point sweep (pinned by
-        tests/test_packed_sweep.py). Packed engines require
-        ``rng="threefry"`` (the counter-based draws whose interval mapping
-        is pure float32) and run unsharded (mesh packing rides the
-        next-TPU-window checklist with the rest of SPMD)."""
+        tests/test_packed_sweep.py). Both generators pack: threefry keys
+        and xoroshiro per-run stream rows are per-run leading-axis inputs
+        either way (``make_keys``), and for xoroshiro the stacked
+        ``mean_interval_ms`` leaf is float64 so the packed interval mapping
+        matches the sequential Python-float broadcast bit-for-bit under
+        JAX_ENABLE_X64 (tpusim.packed.stack_params). Packed engines run
+        unsharded (mesh packing rides the next-TPU-window checklist with
+        the rest of SPMD)."""
         if packed:
             if mesh is not None:
                 raise ValueError(
                     "packed engines run unsharded; mesh grid packing rides "
                     "the next TPU window (ROADMAP)"
-                )
-            if config.rng != "threefry":
-                raise ValueError(
-                    "packed engines need rng='threefry' (per-run params with "
-                    "the float32 interval mapping); xoroshiro grids run "
-                    "sequentially"
                 )
         self.packed = packed
         #: Per-run int64 duration_ms array (packed mode only; None keeps the

@@ -236,6 +236,12 @@ def worker_main(argv: list[str] | None = None) -> int:
     p.add_argument("--result", required=True, type=Path)
     p.add_argument("--heartbeat", required=True, type=Path)
     p.add_argument("--checkpoint", type=Path, default=None)
+    p.add_argument(
+        "--checkpoint-dir", type=Path, default=None,
+        help="per-point piece checkpoints for a --grid unit (tpusim.packed): "
+        "a requeued packed sub-grid resumes mid-pack from these instead of "
+        "restarting the whole unit",
+    )
     p.add_argument("--heartbeat-s", type=float, default=1.0)
     p.add_argument("--single-device", action="store_true")
     p.add_argument("--telemetry", type=Path, default=None)
@@ -280,6 +286,7 @@ def worker_main(argv: list[str] | None = None) -> int:
         rows = run_sweep(
             points, quiet=True, packed=True, chaos=injector,
             telemetry_path=args.telemetry, engine_cache={},
+            checkpoint_dir=args.checkpoint_dir,
             progress=hb.progress,
             use_all_devices=not args.single_device,
         )
@@ -568,7 +575,14 @@ class FleetSupervisor:
             "--heartbeat-s", str(self.heartbeat_s),
         ]
         if asg.get("grid_manifest") is not None:
-            argv += ["--grid", str(asg["grid_manifest"])]
+            # The shared checkpoint dir (per-point files named by point, the
+            # run_sweep convention): a replacement worker for a killed packed
+            # unit heals MID-PACK from the piece checkpoints instead of
+            # restarting the whole sub-grid.
+            argv += [
+                "--grid", str(asg["grid_manifest"]),
+                "--checkpoint-dir", str(self.state_dir / "checkpoints"),
+            ]
         else:
             argv += [
                 "--point", asg["point"],
@@ -1089,8 +1103,9 @@ def main(argv: list[str] | None = None) -> int:
         "--packed", action="store_true",
         help="dispatch whole sub-grids per worker as packed device programs "
         "(tpusim.packed) instead of single points; leases and quarantine "
-        "operate at sub-grid granularity (a requeued grid restarts whole — "
-        "packed units carry no per-point checkpoints)",
+        "operate at sub-grid granularity, and a requeued grid heals "
+        "MID-PACK from the shared per-point piece checkpoints "
+        "(state-dir/checkpoints, written after every packed dispatch)",
     )
     p.add_argument(
         "--grid-size", type=int, default=None,

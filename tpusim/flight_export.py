@@ -55,7 +55,8 @@ from .flight import FLIGHT_TIME_BASE, KIND_NAMES, N_FIELDS
 from .tracing import _write_artifact, validate_perfetto
 
 __all__ = [
-    "FlightLog", "decode_flight", "events_jsonl", "perfetto_trace",
+    "FlightLog", "decode_flight", "decode_flight_packed", "events_jsonl",
+    "perfetto_trace",
     "validate_perfetto", "TraceDiff", "load_events_jsonl", "diff_event_logs",
     "main",
 ]
@@ -108,6 +109,36 @@ def decode_flight(sums: dict[str, Any], *, start: int = 0) -> FlightLog:
                 "depth": int(row[3]),
             })
     return FlightLog(events=events, dropped=dropped, capacity=capacity)
+
+
+def decode_flight_packed(
+    sums: dict[str, Any], pieces: list[tuple[int, int, int]]
+) -> dict[int, FlightLog]:
+    """Decode one PACKED dispatch's rings (tpusim.packed): the runs axis of
+    ``flight_buf``/``flight_count`` holds the dispatch's pieces back to
+    back, so ``pieces`` — ``(point, start, count)`` triples in pack order,
+    the dispatch's own layout — is the pack-position → (point, run)
+    mapping. Each piece's slice decodes exactly like a sequential batch
+    with the piece's global run offset (``decode_flight(..., start=)``), so
+    run ids round-trip and the per-point logs diff cleanly against a
+    sequential ``tpusim trace``. Pad lanes sit past the last piece and are
+    never decoded. Returns ``{point: FlightLog}`` for the points this
+    dispatch touched."""
+    buf = np.asarray(sums["flight_buf"])
+    cnt = np.asarray(sums["flight_count"])
+    logs: dict[int, FlightLog] = {}
+    off = 0
+    for point, start, count in pieces:
+        sl = slice(off, off + count)
+        log = decode_flight(
+            {"flight_buf": buf[sl], "flight_count": cnt[sl]}, start=start
+        )
+        if point in logs:
+            logs[point].extend(log)
+        else:
+            logs[point] = log
+        off += count
+    return logs
 
 
 def events_jsonl(events: list[dict]) -> str:

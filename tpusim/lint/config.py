@@ -142,6 +142,14 @@ _DEFAULT_FLAG_IGNORE = ()
 #: engine finalize-leaf consumption (the JX012 cross-check set); generic
 #: summary/config dicts that merely reuse a leaf-ish suffix stay out.
 _DEFAULT_LEAF_READ_NAMES = ("raw", "tele_b", "batch_sums")
+#: Modules that consume packed per-run leaves (``*_per_run`` / ``flight_*``)
+#: at piece boundaries — JX012's packed sub-check requires every such leaf an
+#: engine stores to be read by constant name in one of these, or listed in
+#: ``packed-leaf-strip``.
+_DEFAULT_PACKED_CONSUMERS = ("tpusim/packed.py", "tpusim/flight_export.py")
+#: Packed per-run leaves explicitly declared as dropped at piece boundaries
+#: (escape hatch for leaves that are intentionally not sliced per point).
+_DEFAULT_PACKED_LEAF_STRIP: tuple[str, ...] = ()
 _ALL_RULE_IDS = tuple(f"JX{n:03d}" for n in range(1, 14))
 
 
@@ -171,6 +179,8 @@ class LintConfig:
     leaf_merge_suffixes: tuple[str, ...] = _DEFAULT_LEAF_MERGE_SUFFIXES
     combine_merge_literals: tuple[str, ...] = _DEFAULT_COMBINE_MERGE_LITERALS
     leaf_scalar_allowlist: tuple[str, ...] = _DEFAULT_LEAF_SCALARS
+    packed_consumer_modules: tuple[str, ...] = _DEFAULT_PACKED_CONSUMERS
+    packed_leaf_strip: tuple[str, ...] = _DEFAULT_PACKED_LEAF_STRIP
     cli_modules: tuple[str, ...] = _DEFAULT_CLI_MODULES
     flag_ignore: tuple[str, ...] = _DEFAULT_FLAG_IGNORE
 
@@ -219,6 +229,8 @@ def load_config(pyproject: Path | None = None) -> LintConfig:
         ("leaf_merge_suffixes", "leaf-merge-suffixes"),
         ("combine_merge_literals", "combine-merge-literals"),
         ("leaf_scalar_allowlist", "leaf-scalar-allowlist"),
+        ("packed_consumer_modules", "packed-consumer-modules"),
+        ("packed_leaf_strip", "packed-leaf-strip"),
         ("cli_modules", "cli-modules"),
         ("flag_ignore", "flag-ignore"),
     ):

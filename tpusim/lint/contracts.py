@@ -1147,6 +1147,64 @@ class ProjectContracts:
                             f"dict here but no engine finalize/aux path "
                             f"produces it — renamed counter or dead consumer",
                         )
+        # (5) Packed per-run leaves must declare a piece-boundary fate. Every
+        # `*_per_run` / `flight_*` leaf an engine stores rides the packed
+        # runs-axis, so the packed orchestrators must either slice it per
+        # point at piece boundaries (a constant-key read in one of the
+        # packed-consumer modules) or the config must list it in
+        # packed-leaf-strip as intentionally dropped. A leaf with neither
+        # fate would vanish silently from packed grid results while surviving
+        # the sequential path — exactly the class of drift the packed
+        # completion removed.
+        packed_leaves = {
+            leaf for leaf in stores
+            if leaf.endswith("_per_run") or leaf.startswith("flight_")
+        }
+        packed_reads: set[str] = set()
+        for rel in c.packed_consumer_modules:
+            m = self._load(rel)
+            if m is None:
+                continue
+            funcs = [
+                n for n in ast.walk(m.tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ] + [m.tree]
+            for fn in funcs:
+                env = StrEnv(m, fn)
+                for node in scope_nodes(fn):
+                    # Receiver-agnostic on purpose: the packed modules slice
+                    # these leaves out of several locally-named dicts (raw,
+                    # sums, piece views), and a false "read" here only
+                    # suppresses a finding — the naming contract in (1)
+                    # still covers the leaf itself.
+                    expr = None
+                    if (
+                        isinstance(node, ast.Subscript)
+                        and isinstance(node.ctx, ast.Load)
+                        and not isinstance(node.slice, ast.Name)
+                    ):
+                        expr = node.slice
+                    elif (
+                        isinstance(node, ast.Call)
+                        and _attr_leaf(node.func) in ("get", "pop")
+                        and node.args
+                        and not isinstance(node.args[0], ast.Name)
+                    ):
+                        expr = node.args[0]
+                    if expr is not None:
+                        packed_reads |= env.possible(expr) or set()
+        for leaf in sorted(
+            packed_leaves - packed_reads - set(c.packed_leaf_strip)
+        ):
+            m, node = stores[leaf]
+            yield m.finding(
+                "JX012", node,
+                f"packed leaf `{leaf}` declares no piece-boundary fate — no "
+                f"packed-consumer module ({sorted(c.packed_consumer_modules)})"
+                f" reads it by constant name and packed-leaf-strip does not "
+                f"list it; a packed grid would drop it silently while the "
+                f"sequential path keeps it",
+            )
 
     # -- JX013 -------------------------------------------------------------
 

@@ -17,9 +17,21 @@ recipe of one program over a lattice of configurations, arXiv:1903.11714):
   * **Shape agreement.** Points pack together exactly when they would compile
     the same program (:func:`pack_shape_key` — a jax-free conservative twin
     of ``Engine.reuse_key``): same miner count, mode, resolved chunk budget,
-    rng and compile-time knobs. Points that disagree form separate packs;
-    ``rng="xoroshiro"`` and flight-recorder configs fall back to the
-    sequential path (documented in README "Grid packing").
+    rng and compile-time knobs. Points that disagree form separate packs —
+    ``rng="xoroshiro"`` and flight-recorder grids pack too, each in their
+    own shape group (README "Grid packing"): xoroshiro runs carry per-run
+    stream rows (xoroshiro.pack_run_streams — the global-run-index
+    derivation the native backend uses, so the packed word-consumption
+    order stays byte-diffable via ``tpusim trace diff``), and flight rings
+    are runs-axis leaves decoded per piece
+    (flight_export.decode_flight_packed).
+  * **Per-point checkpoints mid-pack.** ``checkpoint_dir`` writes the SAME
+    fingerprinted per-point npz the sequential runner writes
+    (runner.checkpoint_fingerprint), sliced from the raw per-run leaves at
+    piece (= batch) boundaries after every dispatch — so a killed packed
+    dispatch resumes bit-equal to an uninterrupted one, packed and
+    sequential checkpoints are mutually resumable, and a fleet packed
+    sub-grid unit heals mid-pack instead of restarting the sub-grid.
   * **Per-run -> per-point segment reduction.** A packed engine returns RAW
     per-run leaves (``combine_sums`` concatenates them across any split);
     :func:`_fold_piece` applies, per grid point, byte-for-byte the host
@@ -107,12 +119,16 @@ def pack_shape_key(cfg: SimConfig) -> tuple:
 
 
 def packable(cfg: SimConfig) -> bool:
-    """Whether this point may enter a pack at all (the fallback rules the
-    README documents): packed engines need the counter-based threefry draws
-    (per-run params with the pure-float32 interval mapping) and no flight
-    recorder (per-run event rings are single-point tooling — ``tpusim
-    trace`` never packs)."""
-    return cfg.rng == "threefry" and cfg.flight_capacity == 0
+    """Whether this point may enter a pack at all. Always True since the
+    packed-path completion: xoroshiro points pack with per-run stream rows,
+    flight-recorder points pack with per-piece ring decode, and
+    checkpointed grids slice piece checkpoints mid-pack — each forms its
+    own shape group via :func:`pack_shape_key`. The remaining carve-outs
+    (device meshes / multi-controller, README "Grid packing") are
+    environment properties, not config ones, and are enforced where the
+    mesh exists (``Engine(packed=True)`` rejects a mesh). Kept as a seam so
+    any future per-config restriction lands in one place."""
+    return True
 
 
 def packed_count_dtype(configs: Iterable[SimConfig]) -> str:
@@ -195,8 +211,20 @@ def stack_params(configs: list[SimConfig], counts: list[int]):
         arr = np.stack([np.asarray(v) for v in leaves])
         return jnp.asarray(np.repeat(arr, reps, axis=0))
 
+    # threefry: float32 per-run scalar — every consumer casts to f32 anyway
+    # (sampling.interval_from_bits), so the value each run sees is
+    # bit-identical to the sequential engine's Python-float broadcast.
+    # xoroshiro: float64 — the interval mapping
+    # (xoroshiro.interval_ms_from_word) multiplies the mean in f64 under
+    # JAX_ENABLE_X64 (the native-A/B contract), and an f32 leaf would round
+    # `mean * 1e6` differently from the sequential Python-float product;
+    # without x64 jnp downcasts the leaf to f32, matching the sequential
+    # cast. Uniform per pack: rng is in pack_shape_key.
+    mean_dtype = (
+        np.float64 if configs[0].rng == "xoroshiro" else np.float32
+    )
     mean = np.repeat(
-        np.asarray([p.mean_interval_ms for p in per], dtype=np.float32), reps
+        np.asarray([p.mean_interval_ms for p in per], dtype=mean_dtype), reps
     )
     from .state import SimParams
 
@@ -204,9 +232,6 @@ def stack_params(configs: list[SimConfig], counts: list[int]):
         thresholds=stack([p.thresholds for p in per]),
         prop_ms=stack([p.prop_ms for p in per]),
         selfish=stack([p.selfish for p in per]),
-        # float32 per-run scalar: every consumer casts to f32 anyway
-        # (sampling.interval_from_bits), so the value each run sees is
-        # bit-identical to the sequential engine's Python-float broadcast.
         mean_interval_ms=jnp.asarray(mean),
         thr64_hi=stack([p.thr64_hi for p in per]),
         thr64_lo=stack([p.thr64_lo for p in per]),
@@ -225,11 +250,16 @@ class _Piece:
     count: int
 
 
-def _point_pieces(cfg: SimConfig) -> list[tuple[int, int]]:
+def _point_pieces(cfg: SimConfig, start: int = 0) -> list[tuple[int, int]]:
+    """Piece layout of one point's runs from global run index ``start``
+    (nonzero on checkpoint resume — batches are cut from ``runs_done``
+    forward, NOT re-aligned to absolute boundaries, exactly the sequential
+    runner's resume semantics so the float64 fold order matches a resumed
+    sequential sweep too)."""
     batch = max(1, min(cfg.batch_size, cfg.runs))
     return [
-        (start, min(batch, cfg.runs - start))
-        for start in range(0, cfg.runs, batch)
+        (s, min(batch, cfg.runs - s))
+        for s in range(start, cfg.runs, batch)
     ]
 
 
@@ -468,23 +498,39 @@ def _dispatch(
     assert npad >= 0, (width, total)
     cfgs = [members[p.point] for p in pieces]
     counts = [p.count for p in pieces]
+    xoro = members[0].rng == "xoroshiro"  # uniform per pack (pack_shape_key)
     durations = np.repeat(
         np.asarray([c.duration_ms for c in cfgs], np.int64), counts
     )
-    key_data = np.repeat(
-        np.stack([_base_key_data(c.seed) for c in cfgs]), counts, axis=0
-    )
-    idx = np.concatenate(
-        [np.arange(p.start, p.start + p.count) for p in pieces]
-    )
+    if xoro:
+        # Per-run stream rows from each piece's GLOBAL run indices — the
+        # native backend's own derivation (xoroshiro.engine_run_seeds), so
+        # the packed word-consumption order per run is byte-identical to a
+        # sequential dispatch and to `tpusim trace --backend cpp`.
+        from .xoroshiro import pack_run_streams
+
+        streams = [
+            pack_run_streams(c.seed, p.start, p.count)
+            for c, p in zip(cfgs, pieces)
+        ]
+    else:
+        key_data = np.repeat(
+            np.stack([_base_key_data(c.seed) for c in cfgs]), counts, axis=0
+        )
+        idx = np.concatenate(
+            [np.arange(p.start, p.start + p.count) for p in pieces]
+        )
     if npad:
         cfgs = cfgs + [cfgs[0]]
         counts = counts + [npad]
         durations = np.concatenate([durations, np.zeros(npad, np.int64)])
-        key_data = np.concatenate(
-            [key_data, np.repeat(_base_key_data(0)[None], npad, axis=0)]
-        )
-        idx = np.concatenate([idx, np.arange(npad)])
+        if xoro:
+            streams.append(pack_run_streams(0, 0, npad))
+        else:
+            key_data = np.concatenate(
+                [key_data, np.repeat(_base_key_data(0)[None], npad, axis=0)]
+            )
+            idx = np.concatenate([idx, np.arange(npad)])
     layout = ("packed_params", tuple(cfgs), tuple(counts))
     params = params_cache.get(layout) if params_cache is not None else None
     if params is None:
@@ -493,7 +539,12 @@ def _dispatch(
             params_cache[layout] = params
     eng.params = params
     eng.run_durations = durations
-    keys = _batch_run_keys(key_data, idx)
+    if xoro:
+        import jax.numpy as jnp
+
+        keys = jnp.asarray(np.concatenate(streams))
+    else:
+        keys = _batch_run_keys(key_data, idx)
     raw = eng.run_batch(keys, host_loop=host_loop, pipelined=pipelined)
     return raw
 
@@ -508,21 +559,32 @@ def run_grid(
     pipelined: bool = False,
     telemetry=None,
     chaos=None,
+    checkpoint_dir=None,
     pallas_kwargs: dict | None = None,
     progress=None,
 ) -> list[dict[str, Any]]:
-    """Run every (packable) point of one shape-agreement pack as packed
-    device dispatches; returns one result dict per point, in input order:
+    """Run every point of one shape-agreement pack as packed device
+    dispatches; returns one result dict per point, in input order:
     ``{"name", "results": SimResults, "sums", "moments", "tele",
-    "elapsed_s"}``. ``points`` must all share one :func:`pack_shape_key`
+    "elapsed_s"}`` (plus ``"flight"``: a decoded
+    :class:`~tpusim.flight_export.FlightLog` when the pack records flight
+    events). ``points`` must all share one :func:`pack_shape_key`
     (``run_sweep(packed=True)`` plans the partition; this function trusts
     it). ``pack_width`` fixes the dispatch width (defaults to the largest
     member ``batch_size``, clamped to the grid total) — every dispatch of a
     multi-dispatch grid is padded to it, so the whole grid compiles ONE
     program and a second same-width grid compiles nothing
     (compile_count_guard(exact=0), tests/test_packed_sweep.py).
-    ``progress(done_runs, total_runs)`` fires after every dispatch with
-    grid-cumulative counts — the runner's per-batch callback contract, so a
+    ``checkpoint_dir`` arms per-point piece checkpoints: after every
+    dispatch each touched point's accumulated sums are saved to
+    ``<dir>/<name>.npz`` in the sequential runner's fingerprinted format
+    (runner.checkpoint_fingerprint), and points with a matching checkpoint
+    resume from their saved run index — bit-equal to an uninterrupted run,
+    and interchangeable with the sequential path's checkpoints (moments and
+    flight events stay session-scoped across a resume, like the sequential
+    runner's). ``progress(done_runs, total_runs)`` fires after every
+    dispatch with grid-cumulative counts (a resumed grid starts at its
+    checkpointed base) — the runner's per-batch callback contract, so a
     fleet worker's heartbeat can carry packed progress too."""
     members = [cfg for _, cfg in points]
     names = [name for name, _ in points]
@@ -560,6 +622,7 @@ def run_grid(
             eng, members, names, pack_width=pack_width,
             host_loop=host_loop, pipelined=pipelined,
             engine_cache=engine_cache, telemetry=telemetry,
+            chaos=chaos, checkpoint_dir=checkpoint_dir,
             progress=progress, t0=t0,
         )
     finally:
@@ -569,36 +632,99 @@ def run_grid(
 
 def _run_grid_dispatches(
     eng, members, names, *, pack_width, host_loop, pipelined,
-    engine_cache, telemetry, progress, t0,
+    engine_cache, telemetry, progress, t0, chaos=None, checkpoint_dir=None,
 ) -> list[dict[str, Any]]:
     m = members[0].network.n_miners
-
-    # Pieces in point order, cut at each point's own batch boundaries.
-    pieces: list[_Piece] = []
-    for i, cfg in enumerate(members):
-        pieces.extend(_Piece(i, s, c) for s, c in _point_pieces(cfg))
-    total = sum(p.count for p in pieces)
-    width = pack_width or min(total, max(c.batch_size for c in members))
-    width = max(width, max(p.count for p in pieces))
-    width = _pad_width(min(width, total) if pack_width is None else width, eng)
-
-    # Greedy fill: consecutive pieces until the width is reached. Every
-    # dispatch is padded to the shared width so the compiled program is one.
-    dispatches: list[list[_Piece]] = [[]]
-    fill = 0
-    for p in pieces:
-        if fill + p.count > width and dispatches[-1]:
-            dispatches.append([])
-            fill = 0
-        dispatches[-1].append(p)
-        fill += p.count
+    flight = members[0].flight_capacity > 0  # uniform per pack (shape key)
 
     state = [
         {"sums": _zero_point_sums(m), "moments": MomentAccumulator(),
          "tele": _zero_point_tele(m)}
         for _ in members
     ]
-    runs_done = 0
+    if flight:
+        from .flight_export import FlightLog
+
+        for i, cfg in enumerate(members):
+            state[i]["flight"] = FlightLog(
+                events=[], dropped={}, capacity=cfg.flight_capacity
+            )
+
+    # Per-point piece checkpoints: the sequential runner's own fingerprinted
+    # npz (same filename convention as run_sweep's sequential path), loaded
+    # before piecing so a resumed point's remaining batches are cut from its
+    # saved run index forward — exactly the sequential resume semantics.
+    ckpts: list = [None] * len(members)
+    done = [0] * len(members)
+    if checkpoint_dir is not None:
+        from pathlib import Path
+
+        from .runner import _Checkpoint, checkpoint_fingerprint
+
+        ckdir = Path(checkpoint_dir)
+        ckdir.mkdir(parents=True, exist_ok=True)
+        for i, cfg in enumerate(members):
+            ck = _Checkpoint(
+                ckdir / f"{names[i]}.npz",
+                checkpoint_fingerprint(cfg, _resolved_chunk_steps(cfg)),
+                chaos=chaos,
+            )
+            ckpts[i] = ck
+            t_ld = time.perf_counter()
+            loaded = ck.load()
+            if loaded is None:
+                continue
+            runs_loaded, saved = loaded
+            done[i] = min(int(runs_loaded), cfg.runs)
+            sums = state[i]["sums"]
+            for k in sums:
+                # Fold onto the zero template (keeps the int64/float64
+                # accumulator dtypes) — schema equality with the sequential
+                # checkpoint is pinned by the lint contract and tests.
+                sums[k] = sums[k] + saved[k]
+            logger.info(
+                "resuming packed point %s from checkpoint at %d/%d runs",
+                names[i], done[i], cfg.runs,
+            )
+            if telemetry is not None:
+                dur_ld = time.perf_counter() - t_ld
+                telemetry.emit(
+                    "checkpoint_load", t_start=time.time() - dur_ld,
+                    dur_s=dur_ld, runs_done=done[i], path=str(ck.path),
+                    point=names[i], packed=True,
+                )
+
+    # Pieces in point order, cut at each point's own batch boundaries (from
+    # its resumed run index forward, matching a resumed sequential sweep).
+    pieces: list[_Piece] = []
+    for i, cfg in enumerate(members):
+        pieces.extend(_Piece(i, s, c) for s, c in _point_pieces(cfg, done[i]))
+    total = sum(c.runs for c in members)
+    runs_done = sum(done)
+    dispatches: list[list[_Piece]] = []
+    width = 0
+    if pieces:
+        width = pack_width or min(
+            sum(p.count for p in pieces), max(c.batch_size for c in members)
+        )
+        width = max(width, max(p.count for p in pieces))
+        width = _pad_width(
+            min(width, sum(p.count for p in pieces))
+            if pack_width is None else width, eng,
+        )
+
+        # Greedy fill: consecutive pieces until the width is reached. Every
+        # dispatch is padded to the shared width so the compiled program is
+        # one.
+        dispatches.append([])
+        fill = 0
+        for p in pieces:
+            if fill + p.count > width and dispatches[-1]:
+                dispatches.append([])
+                fill = 0
+            dispatches[-1].append(p)
+            fill += p.count
+
     for di, batch in enumerate(dispatches):
         t_d = time.monotonic()
         raw = _dispatch(
@@ -609,8 +735,34 @@ def _run_grid_dispatches(
         off = 0
         for p in batch:
             _fold_piece(state[p.point], raw, slice(off, off + p.count))
+            done[p.point] += p.count
             off += p.count
+        if flight:
+            from .flight_export import decode_flight_packed
+
+            logs = decode_flight_packed(
+                {"flight_buf": raw["flight_buf"],
+                 "flight_count": raw["flight_count"]},
+                [(p.point, p.start, p.count) for p in batch],
+            )
+            for pt, log in logs.items():
+                state[pt]["flight"].extend(log)
         runs_done += sum(p.count for p in batch)
+        if checkpoint_dir is not None:
+            # Save every point the dispatch touched — the packed twin of the
+            # runner's per-batch save, so a kill between dispatches loses at
+            # most one dispatch of work per point.
+            for pt in sorted({p.point for p in batch}):
+                t_ck = time.perf_counter()
+                ckpts[pt].save(done[pt], state[pt]["sums"])
+                if telemetry is not None:
+                    dur_ck = time.perf_counter() - t_ck
+                    telemetry.emit(
+                        "checkpoint_save", t_start=time.time() - dur_ck,
+                        dur_s=dur_ck, runs_done=done[pt],
+                        path=str(ckpts[pt].path), point=names[pt],
+                        packed=True,
+                    )
         if progress is not None:
             progress(runs_done, total)
         if telemetry is not None:
@@ -651,11 +803,15 @@ def _run_grid_dispatches(
                 packed=True,
                 stats=st["moments"].snapshot(),
             )
-        out.append({
+        row = {
             "name": name, "results": res, "sums": st["sums"],
             "moments": st["moments"], "tele": st["tele"],
             "elapsed_s": elapsed,
-        })
+        }
+        if flight:
+            st["flight"].events.sort(key=lambda e: (e["run"], e["seq"]))
+            row["flight"] = st["flight"]
+        out.append(row)
     return out
 
 

@@ -96,8 +96,27 @@ SWEEP_PROTOCOL: dict[str, dict[str, Any]] = {
 #: and its ``sweep_sequential`` before-twin.
 SWEEP_SCENARIO = "packed_sweep"
 
+#: Sweep-protocol variants (the packed-path-completion teeth): same grid,
+#: same interleaved A/B discipline, but with the formerly-fallback features
+#: armed — per-point checkpoints on BOTH paths ("ckpt": fresh checkpoint
+#: dir per sweep call, so resume never silently skips the work being
+#: timed), and the native-A/B generator ("xoro": rng="xoroshiro"). Each
+#: emits ONE ledger row (``sweep_packed_ckpt`` / ``sweep_packed_xoro``)
+#: whose extra records its own forced-sequential baseline and speedup.
+SWEEP_VARIANTS: dict[str, str] = {
+    "packed_sweep_ckpt": "ckpt",
+    "packed_sweep_xoro": "xoro",
+}
+
+#: Every scenario that runs the sweep protocol (engine-unpinnable: run_sweep
+#: has no engine knob, so --engine cannot pin any of these).
+SWEEP_SCENARIOS = (SWEEP_SCENARIO, *SWEEP_VARIANTS)
+
 #: ``perf run``'s default scenario set (``--scenarios`` unset).
-DEFAULT_RUN_SCENARIOS = "fast,exact,fast_yearlong,packed_sweep"
+DEFAULT_RUN_SCENARIOS = (
+    "fast,exact,fast_yearlong,packed_sweep,packed_sweep_ckpt,"
+    "packed_sweep_xoro"
+)
 
 def _git_rev() -> str | None:
     try:
@@ -330,23 +349,37 @@ def run_protocol(
 
 
 def run_sweep_protocol(
-    *, quick: bool = False, repeats: int | None = None
+    *, quick: bool = False, repeats: int | None = None,
+    variant: str | None = None,
 ) -> list[dict]:
     """Measure grid points/sec on the scaled reference selfish-threshold
-    grid, sequential vs packed dispatch, and return BOTH ledger rows
-    (``sweep_sequential`` / ``sweep_packed``, better=higher, value = best
-    repeat). Both paths run through ``run_sweep`` on one shared engine cache
-    after a warmup pass of each, so compiles are excluded and the repeats
-    time pure dispatch+reduction; the packed row records its measured
-    ``speedup_x`` over the sequential best."""
+    grid, sequential vs packed dispatch. With ``variant=None`` returns BOTH
+    ledger rows (``sweep_sequential`` / ``sweep_packed``, better=higher,
+    value = best repeat). ``variant="ckpt"`` arms per-point checkpoints on
+    BOTH paths (a FRESH checkpoint dir per sweep call — a reused dir would
+    resume past the work being timed and measure nothing) and
+    ``variant="xoro"`` runs the grid with ``rng="xoroshiro"``; each returns
+    ONE row (``sweep_packed_ckpt`` / ``sweep_packed_xoro``) whose extra
+    records the variant's own forced-sequential best (same arming) and the
+    measured ``speedup_x`` over it. All paths run through ``run_sweep`` on
+    one shared engine cache after a warmup pass of each, so compiles are
+    excluded and the repeats time pure dispatch+reduction (+ checkpoint I/O
+    for the ckpt variant — that is the point: durability must not cost the
+    packed win)."""
+    import shutil
+    import tempfile
+
     from .config import NetworkConfig, SimConfig
     from .sweep import _selfish_network, run_sweep
 
+    if variant not in (None, "ckpt", "xoro"):
+        raise ValueError(f"unknown sweep variant {variant!r}")
     p = dict(SWEEP_PROTOCOL["quick" if quick else "full"])
     if repeats is not None:
         p["repeats"] = repeats
     duration_ms = int(p["duration_ms"])
     batch = len(p["pcts"]) * int(p["runs"])
+    rng = "xoroshiro" if variant == "xoro" else "threefry"
     points = []
     for interval_s in p["intervals"]:
         for pct in p["pcts"]:
@@ -355,23 +388,38 @@ def run_sweep_protocol(
             points.append((
                 f"interval-{int(interval_s)}s-selfish-{pct}pct",
                 SimConfig(network=net, runs=int(p["runs"]),
-                          duration_ms=duration_ms, batch_size=batch, seed=7),
+                          duration_ms=duration_ms, batch_size=batch, seed=7,
+                          rng=rng),
             ))
     cfg0 = points[0][1]
     cache: dict = {}
+    ckpt_root = (
+        Path(tempfile.mkdtemp(prefix="tpusim-perf-ckpt-"))
+        if variant == "ckpt" else None
+    )
+    calls = {"n": 0}
 
     def sweep(packed: bool) -> None:
-        run_sweep(points, quiet=True, engine_cache=cache, packed=packed)
+        kwargs: dict[str, Any] = {}
+        if ckpt_root is not None:
+            calls["n"] += 1
+            kwargs["checkpoint_dir"] = ckpt_root / f"call{calls['n']:03d}"
+        run_sweep(points, quiet=True, engine_cache=cache, packed=packed,
+                  **kwargs)
 
-    sweep(False)
-    sweep(True)  # warmup both paths: every program compiled, caches primed
-    n = len(points)
-    samples: dict[bool, list[float]] = {False: [], True: []}
-    for _ in range(int(p["repeats"])):
-        for packed in (False, True):  # interleaved A/B
-            t0 = time.perf_counter()
-            sweep(packed)
-            samples[packed].append(n / (time.perf_counter() - t0))
+    try:
+        sweep(False)
+        sweep(True)  # warmup both paths: every program compiled, caches primed
+        n = len(points)
+        samples: dict[bool, list[float]] = {False: [], True: []}
+        for _ in range(int(p["repeats"])):
+            for packed in (False, True):  # interleaved A/B
+                t0 = time.perf_counter()
+                sweep(packed)
+                samples[packed].append(n / (time.perf_counter() - t0))
+    finally:
+        if ckpt_root is not None:
+            shutil.rmtree(ckpt_root, ignore_errors=True)
     shape = {
         "points": n,
         "runs_per_point": int(p["runs"]),
@@ -384,13 +432,23 @@ def run_sweep_protocol(
         "count_rebase": cfg0.count_rebase,
     }
     protocol = "quick" if quick else "full"
+    speedup = round(max(samples[True]) / max(samples[False]), 3)
+    if variant is not None:
+        # One row per variant: its sequential baseline (with the SAME
+        # arming) is evidence, not a gated scenario of its own.
+        return [perf_row(
+            f"sweep_packed_{variant}", "points_per_s", max(samples[True]),
+            unit="points/s", better="higher", samples=samples[True],
+            shape={**shape, "packed": True, "rng": rng,
+                   "checkpointed": variant == "ckpt"},
+            extra={"protocol": protocol, "speedup_x": speedup,
+                   "sequential_best": round(max(samples[False]), 3)},
+        )]
     rows = []
     for packed, scenario in ((False, "sweep_sequential"), (True, "sweep_packed")):
         extra: dict[str, Any] = {"protocol": protocol}
         if packed:
-            extra["speedup_x"] = round(
-                max(samples[True]) / max(samples[False]), 3
-            )
+            extra["speedup_x"] = speedup
         rows.append(perf_row(
             scenario, "points_per_s", max(samples[packed]),
             unit="points/s", better="higher", samples=samples[packed],
@@ -568,7 +626,10 @@ def main(argv: list[str] | None = None) -> int:
                        help="comma-separated subset of "
                             f"{DEFAULT_RUN_SCENARIOS} (the default; "
                             "packed_sweep emits the sweep_sequential + "
-                            "sweep_packed points/sec pair)")
+                            "sweep_packed points/sec pair, and "
+                            "packed_sweep_ckpt/packed_sweep_xoro one "
+                            "sweep_packed_ckpt/sweep_packed_xoro row each "
+                            "with checkpoints / rng=xoroshiro armed)")
     p_run.add_argument("--runs", type=int)
     p_run.add_argument("--n-chunks", type=int)
     p_run.add_argument("--repeats", type=int)
@@ -596,21 +657,23 @@ def main(argv: list[str] | None = None) -> int:
             s for s in (args.scenarios or DEFAULT_RUN_SCENARIOS).split(",")
             if s
         )
-        if SWEEP_SCENARIO in scenarios and args.engine != "auto":
+        sweep_requested = tuple(s for s in scenarios if s in SWEEP_SCENARIOS)
+        if sweep_requested and args.engine != "auto":
             # run_sweep_protocol measures the auto-selected engine pair end
             # to end (run_sweep has no engine knob); appending its rows
             # under a pinned --engine would mislabel the ledger.
             if explicit:
                 ap.error(
                     f"--engine {args.engine} cannot pin the "
-                    f"{SWEEP_SCENARIO} scenario (the sweep protocol "
-                    f"measures the auto-selected engine); drop it from "
-                    f"--scenarios or use --engine auto"
+                    f"{'/'.join(sweep_requested)} scenario(s) (the sweep "
+                    f"protocol measures the auto-selected engine); drop "
+                    f"them from --scenarios or use --engine auto"
                 )
-            print(f"[perf] skipping {SWEEP_SCENARIO}: --engine "
+            print(f"[perf] skipping {'/'.join(sweep_requested)}: --engine "
                   f"{args.engine} pins the chained scenarios only")
-            scenarios = tuple(s for s in scenarios if s != SWEEP_SCENARIO)
-        chained = tuple(s for s in scenarios if s != SWEEP_SCENARIO)
+            scenarios = tuple(s for s in scenarios if s not in SWEEP_SCENARIOS)
+            sweep_requested = ()
+        chained = tuple(s for s in scenarios if s not in SWEEP_SCENARIOS)
         rows = []
         if chained:
             rows += run_protocol(
@@ -618,8 +681,11 @@ def main(argv: list[str] | None = None) -> int:
                 runs=args.runs, n_chunks=args.n_chunks, repeats=args.repeats,
                 chunk_steps=args.chunk_steps,
             )
-        if SWEEP_SCENARIO in scenarios:
-            rows += run_sweep_protocol(quick=args.quick, repeats=args.repeats)
+        for scenario in sweep_requested:
+            rows += run_sweep_protocol(
+                quick=args.quick, repeats=args.repeats,
+                variant=SWEEP_VARIANTS.get(scenario),
+            )
         if args.out is not None:
             out = args.out
         else:

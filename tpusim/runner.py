@@ -40,7 +40,7 @@ logger = logging.getLogger("tpusim")
 
 __all__ = [
     "run_simulation_config", "make_run_keys", "make_engine",
-    "CheckpointMismatchError",
+    "checkpoint_fingerprint", "CheckpointMismatchError",
 ]
 
 
@@ -223,6 +223,48 @@ class _Checkpoint:
             self.chaos.fire("checkpoint.save", phase="post_replace", runs_done=runs_done)
 
 
+def checkpoint_fingerprint(config: SimConfig, chunk_steps: int) -> str:
+    """The per-point checkpoint identity: everything that affects per-run
+    sampling, nothing that doesn't. Shared by the sequential runner
+    (``chunk_steps`` = the engine's resolved budget) and the packed
+    dispatcher (``config.resolved_chunk_steps`` — pinned equal by
+    tests/test_packed_sweep.py), so packed and sequential checkpoints of
+    one point are MUTUALLY resumable.
+
+    `runs` and `batch_size` are excluded so a checkpointed sweep can be
+    extended or re-batched without invalidating accumulated statistics.
+    Flight recording is observational — it changes no draw and no statistic
+    (pinned by tests/test_flight.py) — so it stays out and pre-flight
+    checkpoints keep resuming. The superstep width K changes only how many
+    events one device loop iteration unrolls — the per-event draw mapping
+    (and therefore every statistic) is bit-identical across K. Batched wide
+    RNG and the packed-state dtype are pure compile-time knobs (pinned by
+    tests/test_rng_batch.py), as are the miner-axis gather reads and
+    per-chunk count re-basing (tests/test_consensus_gather.py) — all stay
+    out, so checkpoints resume across those knobs and across versions from
+    before they existed. The default generator is omitted so checkpoints
+    from before the rng field existed (identical threefry draws) still
+    resume; non-default generators fingerprint explicitly. mode/group_slots
+    /chunk_steps fingerprint their *resolved* values: "auto" routing rules
+    may change between versions, and a resumed sweep must never silently
+    merge fast-mode (lower-bound stale) sums with exact-mode ones."""
+    fp_dict = json.loads(config.to_json())
+    fp_dict.pop("runs", None)
+    fp_dict.pop("batch_size", None)
+    fp_dict.pop("flight_capacity", None)
+    fp_dict.pop("superstep", None)
+    fp_dict.pop("rng_batch", None)
+    fp_dict.pop("state_dtype", None)
+    fp_dict.pop("consensus_gather", None)
+    fp_dict.pop("count_rebase", None)
+    if fp_dict.get("rng") == "threefry":
+        fp_dict.pop("rng")
+    fp_dict["mode"] = config.resolved_mode
+    fp_dict["group_slots"] = config.resolved_group_slots
+    fp_dict["chunk_steps"] = chunk_steps
+    return json.dumps(fp_dict, sort_keys=True)
+
+
 def run_simulation_config(
     config: SimConfig,
     *,
@@ -371,55 +413,12 @@ def run_simulation_config(
         # single-device engine rather than silently changing the run count.
         engine_unsharded: Engine | None = None
 
-        # Everything that affects per-run sampling identity; `runs` and
-        # `batch_size` are excluded so a checkpointed sweep can be extended or
-        # re-batched without invalidating accumulated statistics.
-        fp_dict = json.loads(config.to_json())
-        fp_dict.pop("runs", None)
-        fp_dict.pop("batch_size", None)
-        # Flight recording is observational — it changes no draw and no statistic
-        # (pinned by tests/test_flight.py) — so it stays out of the fingerprint
-        # and pre-flight checkpoints keep resuming.
-        fp_dict.pop("flight_capacity", None)
-        # The superstep width K changes only how many events one device loop
-        # iteration unrolls — the per-event draw mapping (and therefore every
-        # statistic) is bit-identical across K — so it stays out of the
-        # fingerprint (which also keeps pre-superstep checkpoints resumable).
-        fp_dict.pop("superstep", None)
-        # Batched wide RNG and the packed-state dtype are pure compile-time
-        # knobs: the draws, their consumption order and every statistic are
-        # bit-identical either way (pinned by tests/test_rng_batch.py), so both
-        # stay out — checkpoints resume across rng_batch/state_dtype changes and
-        # across versions from before the knobs existed.
-        fp_dict.pop("rng_batch", None)
-        fp_dict.pop("state_dtype", None)
-        # Same contract for the miner-axis gather reads and per-chunk count
-        # re-basing (pinned by tests/test_consensus_gather.py): statistics
-        # are bit-identical with either knob in either position, so a
-        # checkpoint written re-based resumes un-rebased (and vice versa),
-        # and pre-knob checkpoints keep resuming.
-        fp_dict.pop("consensus_gather", None)
-        fp_dict.pop("count_rebase", None)
-        # The default generator is omitted so checkpoints from before the rng
-        # field existed (identical threefry draws) still resume; non-default
-        # generators fingerprint explicitly.
-        if fp_dict.get("rng") == "threefry":
-            fp_dict.pop("rng")
-        # mode="auto"'s routing rules may change between versions (e.g. the
-        # race-ratio threshold); fingerprint the *resolved* representation so a
-        # resumed sweep can never silently merge fast-mode (lower-bound stale)
-        # sums with exact-mode ones.
-        fp_dict["mode"] = config.resolved_mode
-        # Like mode: group_slots=None resolves by mode and the resolved buffer
-        # size affects overflow behavior, so it is part of the identity.
-        fp_dict["group_slots"] = config.resolved_group_slots
-        # chunk_steps=None resolves to an engine-chosen default that may change
-        # between versions; fingerprint the *resolved* value, which is what fixes
-        # the step->key sampling identity.
-        fp_dict["chunk_steps"] = eng.chunk_steps
-        fingerprint = json.dumps(fp_dict, sort_keys=True)
         ckpt = (
-            _Checkpoint(Path(checkpoint_path), fingerprint, chaos=chaos)
+            _Checkpoint(
+                Path(checkpoint_path),
+                checkpoint_fingerprint(config, eng.chunk_steps),
+                chaos=chaos,
+            )
             if checkpoint_path else None
         )
         runs_done, sums = 0, None

@@ -172,11 +172,13 @@ def run_sweep(
     program shape (tpusim.packed.pack_shape_key) share ONE compiled program
     with their scenario parameters as per-run runtime tensors, and their
     rows are BIT-equal to the sequential sweep (minus the wall-clock
-    fields). Fallback rules (README "Grid packing"): points with
-    ``rng="xoroshiro"`` or an armed flight recorder run sequentially, and
-    ``checkpoint_dir`` disables packing entirely (checkpoints are per-point
-    by construction) with a warning. Rows keep the exact schema and point
-    order either way.
+    fields). ``rng="xoroshiro"`` grids pack with per-run stream seeds,
+    flight-recorder grids pack with per-piece ring decode, and
+    ``checkpoint_dir`` writes the SAME per-point npz checkpoints as the
+    sequential path after every packed dispatch — so a killed packed sweep
+    resumes mid-pack, interchangeably with a sequential resume (README
+    "Grid packing": device meshes / multi-controller are the only remaining
+    carve-outs). Rows keep the exact schema and point order either way.
 
     ``progress(done_runs, total_runs)`` fires as runs complete, cumulative
     over the WHOLE sweep (tpu backend; packed dispatches report per
@@ -201,14 +203,6 @@ def run_sweep(
         )
     if packed and backend != "tpu":
         raise ValueError("packed sweeps need the tpu backend")
-    if packed and checkpoint_dir is not None:
-        import logging
-
-        logging.getLogger("tpusim").warning(
-            "packed sweeps have no per-point checkpoints; --checkpoint-dir "
-            "falls back to the sequential path"
-        )
-        packed = False
 
     done: set[tuple[str, int, str]] = set()
     if resume and out_path is not None and out_path.exists():
@@ -330,7 +324,7 @@ def run_sweep(
             base = runs_done_acc["n"]
             out = run_grid(
                 group, engine_cache=engine_cache, telemetry=recorder,
-                chaos=chaos,
+                chaos=chaos, checkpoint_dir=checkpoint_dir,
                 progress=None if progress is None else (
                     lambda d, t: progress(base + d, total_runs)
                 ),
@@ -399,9 +393,10 @@ def main(argv: list[str] | None = None) -> int:
         "--packed", action="store_true",
         help="run shape-agreeing grid points as packed device programs "
         "(tpusim.packed): one compiled program per shape group, scenario "
-        "params as per-run tensors, rows bit-equal to the sequential sweep "
-        "(xoroshiro/flight points fall back; incompatible with "
-        "--checkpoint-dir)",
+        "params as per-run tensors, rows bit-equal to the sequential sweep; "
+        "xoroshiro and flight-recorder grids pack too, and --checkpoint-dir "
+        "writes the sequential path's per-point npz after every dispatch "
+        "(mid-pack resume)",
     )
     p.add_argument("--quiet", action="store_true")
     p.add_argument(
