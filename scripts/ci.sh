@@ -11,9 +11,9 @@ echo "== tpusim lint =="
 # committed baseline grandfathers old ones. Runs first because it needs no
 # jax import and catches donated-buffer/host-sync/recompile mistakes in
 # seconds, before the expensive legs spin up. The per-module JAX rules
-# (JX001-JX009) AND the cross-module contract pass (JX010-JX013: telemetry
+# (JX001-JX009) AND the cross-module contract pass (JX010-JX014: telemetry
 # span/attr contracts, chaos seam registry, finalize leaf naming, CLI docs
-# drift) run in this one gate.
+# drift, metrics/SLO registry contract) run in this one gate.
 python -m tpusim.cli lint --baseline .tpusim-lint-baseline.json
 # Registration floor: the contract passes must actually be REGISTERED *and*
 # ENABLED — a rule-table slip (a deleted registry row, a pyproject
@@ -21,12 +21,14 @@ python -m tpusim.cli lint --baseline .tpusim-lint-baseline.json
 # that greens while checking nothing. --list-rules annotates disabled rules,
 # so the floor counts rules that will actually RUN in the gate above.
 rule_count=$(python -m tpusim.cli lint --list-rules | grep -cv "(disabled)")
-if [ "$rule_count" -lt 13 ]; then
-  echo "lint gate degraded: only $rule_count rules enabled (need >= 13)" >&2
+if [ "$rule_count" -lt 14 ]; then
+  echo "lint gate degraded: only $rule_count rules enabled (need >= 14)" >&2
   exit 1
 fi
-python -m tpusim.cli lint --list-rules | grep "^JX013" | grep -qv "(disabled)" \
-  || { echo "contract rules missing/disabled in --list-rules" >&2; exit 1; }
+for contract_rule in JX013 JX014; do
+  python -m tpusim.cli lint --list-rules | grep "^$contract_rule" | grep -qv "(disabled)" \
+    || { echo "contract rule $contract_rule missing/disabled in --list-rules" >&2; exit 1; }
+done
 
 echo "== native: build + ASan/UBSan/TSan smoke =="
 make -C native check
@@ -348,6 +350,43 @@ EOF
 # per-(run_id, process) throughput groups.
 env JAX_PLATFORMS=cpu python -m tpusim report "$fleet_dir/drill" \
   | grep -q "Fleet time attribution (critical path)"
+
+echo "== metrics & SLO plane =="
+# The live metrics/SLO plane (tpusim.metrics) against the drill state dir
+# the fleet leg just produced: feed the query-latency histogram with real
+# concurrent packed queries (scripts/loadgen.py appends perf rows INTO the
+# state dir), export + strictly validate the OpenMetrics exposition
+# (declared families, _total counters, cumulative buckets, +Inf == _count,
+# terminal # EOF), smoke the live endpoint with a --once self-scrape,
+# render the shared-evaluator SLO panels in report AND watch, then gate the
+# committed [tool.tpusim-slo] objectives — `slo check` must exit 0. The
+# dead-gate discipline is drilled too: `slo check` over an EMPTY state dir
+# must exit 2 (an empty ledger can never pass green).
+env JAX_PLATFORMS=cpu python scripts/loadgen.py --queries 3 --concurrency 2 \
+  --quiet --out "$fleet_dir/drill/perf/loadgen.jsonl"
+python -m tpusim metrics export "$fleet_dir/drill" \
+  --out "$fleet_dir/metrics.prom" > /dev/null
+python - "$fleet_dir/metrics.prom" <<'EOF'
+from sys import argv
+from tpusim.metrics import validate_openmetrics
+n = validate_openmetrics(open(argv[1]).read())
+assert n > 0, "empty exposition"
+print(f"metrics export: {n} samples validated")
+EOF
+python -m tpusim metrics serve --state-dir "$fleet_dir/drill" --port 0 --once \
+  > "$fleet_dir/scrape.txt"
+grep -q "scrape OK" "$fleet_dir/scrape.txt"
+env JAX_PLATFORMS=cpu python -m tpusim report "$fleet_dir/drill" \
+  --slo-config pyproject.toml | grep -q "SLO status"
+python -m tpusim watch --once "$fleet_dir/drill/fleet.tele.jsonl" \
+  --slo-config pyproject.toml | grep -q "SLO status"
+python -m tpusim slo check "$fleet_dir/drill"
+slo_empty=$(mktemp -d)
+slo_rc=0; python -m tpusim slo check "$slo_empty" > /dev/null 2>&1 || slo_rc=$?
+[ "$slo_rc" -eq 2 ] \
+  || { echo "SLO dead-gate drill: empty state dir exited $slo_rc, want 2" >&2; exit 1; }
+rm -rf "$slo_empty"
+echo "metrics & SLO plane: exposition valid, endpoint scraped, objectives green"
 
 echo "== flight-recorder trace smoke =="
 # One tiny flight-enabled run end-to-end: export the Perfetto trace + JSONL

@@ -81,4 +81,25 @@ for path in sys.argv[1:]:
     print(f"[harvest] {path}: {len(load_rows(path))} perf rows OK")
 EOF
 fi
+# OpenMetrics expositions (`tpusim metrics export --out` from CI legs or
+# hardware windows land under artifacts/metrics/): re-derive a sample
+# exposition from the committed sample fleet ledgers so the evidence stays
+# scrapeable, then strictly validate EVERY collected *.prom file (declared
+# families, _total counters, cumulative buckets, +Inf == _count, terminal
+# # EOF) — a malformed exposition fails the harvest, exactly like a corrupt
+# trace or perf row. jax-free (tpusim.metrics imports no backend).
+mkdir -p artifacts/metrics
+if [ -d artifacts/telemetry/sample_fleet ]; then
+  python -m tpusim metrics export artifacts/telemetry/sample_fleet \
+    --out artifacts/metrics/sample_fleet.prom > /dev/null
+fi
+expositions=$(ls artifacts/metrics/*.prom 2>/dev/null || true)
+if [ -n "$expositions" ]; then
+  python - $expositions <<'EOF'
+import sys
+from tpusim.metrics import validate_openmetrics
+for path in sys.argv[1:]:
+    print(f"[harvest] {path}: {validate_openmetrics(open(path).read())} samples OK")
+EOF
+fi
 git status --short BASELINE.json REFSCALE.md artifacts/

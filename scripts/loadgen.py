@@ -1,0 +1,132 @@
+"""Load generator for the metrics/SLO plane: N concurrent quick-shape sweep
+queries through the packed path, appending per-query latency rows to the
+perf ledger.
+
+Each "query" is what the future serve daemon will answer: a small
+selfish-threshold grid (the ci.sh packed-leg shape) dispatched through
+``run_sweep(..., packed=True)`` against a SHARED engine cache. One untimed
+warmup query compiles the engines; the timed queries then run concurrently
+across ``--concurrency`` worker threads, so the recorded latencies include
+real dispatch contention — the number the p50/p99 SLO gate must hold.
+
+Two perf-ledger rows land per invocation (tpusim.perf schema, scenario
+``loadgen``):
+
+  query_latency_s    value = fastest query, samples = every query's
+                     wall-clock seconds (the metrics plane folds these into
+                     the tpusim_query_latency_seconds histogram)
+  compiles_per_query value = backend compiles observed during the TIMED
+                     phase / queries — the warmed path must not compile, so
+                     the default SLO pins this == 0
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/loadgen.py --queries 4 --concurrency 2 \
+        --out artifacts/perf/loadgen.jsonl
+    python -m tpusim slo check artifacts/perf/
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))  # runnable as `python scripts/loadgen.py`
+
+
+def query_points(seed: int, rng: str = "threefry"):
+    """One query's grid: the ci.sh packed-leg quick shape (2 intervals x
+    1 selfish pct, 8 runs x 1 day, batch 8) — small enough to answer in
+    seconds on CPU, shaped exactly like the real sweep path."""
+    from tpusim.config import NetworkConfig, SimConfig
+    from tpusim.sweep import _selfish_network
+
+    pts = []
+    for interval_s in (300.0, 600.0):
+        net = _selfish_network(30)
+        net = NetworkConfig(miners=net.miners, block_interval_s=interval_s)
+        pts.append((
+            f"q{seed}-i{int(interval_s)}",
+            SimConfig(network=net, runs=8, duration_ms=86_400_000,
+                      batch_size=8, seed=seed, rng=rng),
+        ))
+    return pts
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--queries", type=int, default=4, metavar="N",
+                    help="timed queries to dispatch (default 4)")
+    ap.add_argument("--concurrency", type=int, default=2, metavar="C",
+                    help="concurrent query threads (default 2)")
+    ap.add_argument("--out", type=Path,
+                    default=REPO / "artifacts" / "perf" / "loadgen.jsonl",
+                    help="perf ledger to append the two loadgen rows to")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="base seed; query i runs with seed+1+i")
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+    if args.queries < 1 or args.concurrency < 1:
+        ap.error("--queries and --concurrency must be >= 1")
+
+    from tpusim.perf import append_rows, perf_row
+    from tpusim.sweep import run_sweep
+    from tpusim.testing import subscribe_backend_compiles
+
+    cache: dict = {}
+
+    def run_query(seed: int) -> float:
+        t0 = time.perf_counter()
+        run_sweep(query_points(seed), quiet=True, engine_cache=cache,
+                  packed=True)
+        return time.perf_counter() - t0
+
+    # Warmup: compiles land here, NOT in the timed window. Same shapes as
+    # every timed query, so a compile observed later is a genuine cache
+    # miss on the warmed path — the `compiles_per_query == 0` objective.
+    if not args.quiet:
+        print("[loadgen] warmup query (untimed, compiles expected)...")
+    run_query(args.seed)
+
+    compiles = 0
+
+    def on_compile() -> None:
+        nonlocal compiles
+        compiles += 1
+
+    unsubscribe = subscribe_backend_compiles(on_compile)
+    try:
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=args.concurrency) as pool:
+            latencies = list(pool.map(
+                run_query,
+                [args.seed + 1 + i for i in range(args.queries)],
+            ))
+        wall = time.perf_counter() - t0
+    finally:
+        unsubscribe()
+
+    latencies.sort()
+    shape = {"queries": args.queries, "concurrency": args.concurrency}
+    rows = [
+        perf_row("loadgen", "query_latency_s", latencies[0], unit="s",
+                 samples=latencies, shape=shape),
+        perf_row("loadgen", "compiles_per_query",
+                 compiles / args.queries, unit="count", shape=shape),
+    ]
+    append_rows(args.out, rows)
+    if not args.quiet:
+        mid = latencies[len(latencies) // 2]
+        print(f"[loadgen] {args.queries} queries x {args.concurrency} "
+              f"threads in {wall:.2f}s wall: p50~{mid:.2f}s "
+              f"min {latencies[0]:.2f}s max {latencies[-1]:.2f}s, "
+              f"{compiles} timed-phase compile(s)")
+        print(f"[loadgen] appended 2 rows to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

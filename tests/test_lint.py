@@ -4,7 +4,7 @@ use-after-donation introduced into the REAL engine.py source fails the gate
 (the CI-leg contract); and compile_count_guard pins one-compile-per-shape on
 Engine.run_batch (the runtime half of JX006).
 
-The contract pass (tpusim.lint.contracts, JX010-JX013) gets the same
+The contract pass (tpusim.lint.contracts, JX010-JX014) gets the same
 treatment on synthetic whole-project trees — seeded + clean twin per rule,
 interprocedural **spread resolution, baseline round-trip over the doc/drill
 finding shapes — plus the live CI-gate drill: a span-attr drift and an
@@ -966,7 +966,7 @@ def test_run_batch_compiles_once_per_shape():
 
 
 # ---------------------------------------------------------------------------
-# Contract pass (tpusim.lint.contracts): JX010-JX013 on synthetic projects.
+# Contract pass (tpusim.lint.contracts): JX010-JX014 on synthetic projects.
 
 from tpusim.lint import CONTRACT_RULES, lint_contracts  # noqa: E402
 
@@ -1288,6 +1288,94 @@ def test_jx013_doc_flag_drift_and_ignore(tmp_path):
     assert lint_contracts(tmp_path, cfg, rules=["JX013"]) == []
 
 
+_METRICS_MOD_OK = """
+METRICS = (
+    ("proj_spans", "counter", "spans parsed"),
+    ("proj_latency_seconds", "histogram", "latency"),
+)
+"""
+
+_README_METRICS = _README_OK + """
+<!-- tpusim-lint: metrics-table -->
+| metric | type |
+|---|---|
+| `proj_spans` | counter |
+| `proj_latency_seconds` | histogram |
+"""
+
+_SLO_JSON_OK = (
+    '{"objectives": [{"metric": "proj_spans", "op": ">=", "threshold": 1}]}'
+)
+
+
+def _write_metrics_proj(tmp_path, metrics_mod=_METRICS_MOD_OK,
+                        readme=_README_METRICS, slo=_SLO_JSON_OK, **cfg_over):
+    (tmp_path / "metrics_mod.py").write_text(textwrap.dedent(metrics_mod))
+    (tmp_path / "slo.json").write_text(slo)
+    return _write_contract_proj(
+        tmp_path, readme=readme,
+        metrics_module="metrics_mod.py", slo_config_files=("slo.json",),
+        **cfg_over,
+    )
+
+
+def test_jx014_clean_project(tmp_path):
+    cfg = _write_metrics_proj(tmp_path)
+    assert lint_contracts(tmp_path, cfg, rules=["JX014"]) == []
+
+
+def test_jx014_unregistered_slo_metric_fires(tmp_path):
+    """Direction 1: an objective over a metric the registry never emits is
+    a permanent rc-2 dead gate — flagged statically, at the config line."""
+    slo = ('{"objectives": [\n'
+           '  {"metric": "proj_spans", "op": ">=", "threshold": 1},\n'
+           '  {"metric": "proj_ghost", "op": "<=", "threshold": 9}\n'
+           ']}')
+    cfg = _write_metrics_proj(tmp_path, slo=slo)
+    findings = lint_contracts(tmp_path, cfg, rules=["JX014"])
+    assert any("proj_ghost" in f.message and "no-data" in f.message
+               for f in findings)
+    assert not any("proj_spans" in f.message for f in findings)
+    (hit,) = [f for f in findings if "proj_ghost" in f.message]
+    assert hit.path == "slo.json" and hit.line == 3  # the referencing line
+
+
+def test_jx014_registry_readme_drift_both_directions(tmp_path):
+    # Registry family absent from the documented table fires...
+    readme = _README_METRICS.replace("| `proj_latency_seconds` | histogram |\n", "")
+    cfg = _write_metrics_proj(tmp_path, readme=readme)
+    findings = lint_contracts(tmp_path, cfg, rules=["JX014"])
+    assert any("proj_latency_seconds" in f.message and "missing from" in f.message
+               and f.path == "metrics_mod.py" for f in findings)
+    # ...and a stale table row the registry no longer emits fires too.
+    readme = _README_METRICS + "| `proj_stale` | counter |\n"
+    cfg = _write_metrics_proj(tmp_path, readme=readme)
+    findings = lint_contracts(tmp_path, cfg, rules=["JX014"])
+    assert any("proj_stale" in f.message and "stale" in f.message
+               and f.path == "README.md" for f in findings)
+
+
+def test_jx014_structural_findings(tmp_path):
+    # Missing metrics module: the contract has no registry to pin.
+    cfg = _write_metrics_proj(tmp_path)
+    (tmp_path / "metrics_mod.py").unlink()
+    findings = lint_contracts(tmp_path, cfg, rules=["JX014"])
+    assert any("no registry to pin" in f.message for f in findings)
+    # Module present but no METRICS literal.
+    cfg = _write_metrics_proj(tmp_path, metrics_mod="OTHER = 1\n")
+    findings = lint_contracts(tmp_path, cfg, rules=["JX014"])
+    assert any("METRICS" in f.message for f in findings)
+    # Objective-less SLO config: the runtime gate would exit 2 on it.
+    cfg = _write_metrics_proj(tmp_path, slo='{"objectives": []}')
+    findings = lint_contracts(tmp_path, cfg, rules=["JX014"])
+    assert any("dead gate" in f.message and f.path == "slo.json"
+               for f in findings)
+    # README without the metrics-table marker: cross-check impossible.
+    cfg = _write_metrics_proj(tmp_path, readme=_README_OK)
+    findings = lint_contracts(tmp_path, cfg, rules=["JX014"])
+    assert any("metrics-table" in f.message for f in findings)
+
+
 def test_contract_findings_baseline_round_trip_and_line_shift(tmp_path):
     """Contract findings (including doc/drill ones) ride the same
     line-number-free fingerprints as the per-module rules."""
@@ -1329,17 +1417,17 @@ def test_contract_suppression_comment_in_python(tmp_path):
 
 
 def test_contract_rules_listed_and_registered(capsys):
-    """The CI floor's unit twin: >= 13 rules listed AND enabled for this
+    """The CI floor's unit twin: >= 14 rules listed AND enabled for this
     repo's config (the floor greps out "(disabled)" annotations, so a
     pyproject enabled-rules regression shows up here, not just a registry
     slip)."""
-    assert set(CONTRACT_RULES) == {"JX010", "JX011", "JX012", "JX013"}
+    assert set(CONTRACT_RULES) == {"JX010", "JX011", "JX012", "JX013", "JX014"}
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     enabled_lines = [
         ln for ln in out.splitlines() if ln.strip() and "(disabled)" not in ln
     ]
-    assert len(enabled_lines) >= 13
+    assert len(enabled_lines) >= 14
     for rid in CONTRACT_RULES:
         assert any(ln.startswith(rid) for ln in enabled_lines)
 

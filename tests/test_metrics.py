@@ -1,0 +1,582 @@
+"""Metrics & SLO plane (tpusim.metrics): log-bucketed histograms, ledger ->
+snapshot derivation with EXACT tallies, the OpenMetrics rendition + strict
+validator, the stdlib scrape endpoint over a live state dir, and the
+declarative SLO gate's full exit matrix (0 pass / 1 violation / 2 dead gate).
+
+Everything here is jax-free by design — the module under test must run on a
+host with no backend.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from tpusim.metrics import (
+    CONTENT_TYPE,
+    HIST_BASE,
+    METRICS,
+    LogHistogram,
+    MetricsSnapshot,
+    Objective,
+    SloConfigError,
+    collect_heartbeats,
+    collect_perf_rows,
+    derive_state,
+    evaluate_slos,
+    load_objectives,
+    main,
+    render_openmetrics,
+    serve_metrics,
+    slo_exit_code,
+    slo_main,
+    snapshot_from_spans,
+    validate_openmetrics,
+)
+from tpusim.perf import perf_row
+from tpusim.report import render_report
+from tpusim.watch import render_watch
+
+
+# ---------------------------------------------------------------------------
+# Synthetic ledgers.
+
+RID = "ridmetrics"
+
+
+def _mk(span, t_start, t_mono, dur, process, parent=None, **attrs):
+    row = {
+        "run_id": RID, "span": span, "t_start": t_start, "t_mono": t_mono,
+        "dur_s": dur, "schema": 2, "process": process, "trace_id": RID,
+        "attrs": attrs,
+    }
+    if parent is not None:
+        row["parent_span"] = parent
+    return row
+
+
+def _spans():
+    """A handcrafted ledger with knowable tallies: 2 batch + 1 packed
+    dispatch (7 runs — both span names feed the one dispatch histogram), 2
+    compile, 1 save + 1 load checkpoint, 1 retry, fleet activity (2 spawns,
+    1 requeue, 2 done, 1 quarantine) and a final stats span."""
+    sp = [
+        _mk("batch", 1000.0, 0.0, 0.5, "p0", runs=2),
+        _mk("batch", 1001.0, 1.0, 1.25, "p0", runs=4),
+        _mk("packed_dispatch", 1002.0, 2.0, 3.0, "p0", runs=1, dispatch=0),
+        _mk("compile", 1000.0, 0.0, 2.0, "p0", key="k1"),
+        _mk("compile", 1003.0, 3.0, 0.25, "p0", key="k2"),
+        _mk("checkpoint_save", 1004.0, 4.0, 0.1, "p0"),
+        _mk("checkpoint_load", 1005.0, 5.0, 0.05, "p0"),
+        _mk("retry", 1006.0, 6.0, 0.0, "p0", attempt=1),
+        _mk("fleet_spawn", 1000.0, 0.0, 0.0, "psup", worker="w000", target="a"),
+        _mk("fleet_spawn", 1000.5, 0.5, 0.0, "psup", worker="w001", target="b"),
+        _mk("fleet_requeue", 1002.0, 2.0, 0.0, "psup", worker="w000",
+            target="a", reason="exit:-9"),
+        _mk("fleet_done", 1003.0, 3.0, 0.0, "psup", worker="w001", target="b"),
+        _mk("fleet_done", 1004.0, 4.0, 0.0, "psup", worker="w000", target="a"),
+        _mk("fleet_quarantine", 1005.0, 5.0, 0.0, "psup", target="zz",
+            failures=3, reason="exit:1"),
+        _mk("stats", 1007.0, 7.0, 0.0, "p0",
+            stats={"revenue": {"rel_hw_max": 0.04},
+                   "orphans": {"rel_hw_max": 0.12}}),
+    ]
+    return sp
+
+
+def _write_state(tmp_path: Path, now: float = 2000.0) -> Path:
+    """A full synthetic state dir: supervisor + worker ledgers, a heartbeat
+    file, a loadgen perf ledger, plus one torn line and one foreign file."""
+    state = tmp_path / "state"
+    (state / "workers").mkdir(parents=True)
+    (state / "perf").mkdir()
+    spans = _spans()
+    sup = [sp for sp in spans if sp["process"] == "psup"]
+    wrk = [sp for sp in spans if sp["process"] != "psup"]
+    (state / "fleet.tele.jsonl").write_text(
+        "".join(json.dumps(sp) + "\n" for sp in sup)
+    )
+    # Worker ledger ends on a TORN line (killed mid-append): tolerated,
+    # contributes zero spans.
+    (state / "workers" / "w000.tele.jsonl").write_text(
+        "".join(json.dumps(sp) + "\n" for sp in wrk)
+        + '{"span": "batch", "dur_s": 0.5'
+    )
+    (state / "workers" / "w000.hb.jsonl").write_text(
+        json.dumps({"t": now - 30.0, "beats": 1}) + "\n"
+        + json.dumps({"t": now - 3.0, "beats": 2}) + "\n"
+    )
+    # Foreign JSONL (sweep rows — no span key): zero spans, zero perf rows.
+    (state / "rows.jsonl").write_text('{"label": "pt-a", "stale": 0.1}\n')
+    (state / "perf" / "loadgen.jsonl").write_text(
+        json.dumps(perf_row(
+            "loadgen", "query_latency_s", 0.8, unit="s",
+            samples=[0.8, 1.1, 2.0], shape={"queries": 3, "concurrency": 2},
+        )) + "\n"
+        + json.dumps(perf_row(
+            "loadgen", "compiles_per_query", 0.0, unit="count",
+            shape={"queries": 3},
+        )) + "\n"
+    )
+    return state
+
+
+# ---------------------------------------------------------------------------
+# LogHistogram: exact counts, merge identity, bounded quantile error.
+
+
+def test_histogram_counts_exact_and_merge_identity():
+    values = [0.013, 0.4, 0.5, 1.7, 3.14, 9.9, 42.0, 123.4, 0.0, -1.0]
+    one = LogHistogram()
+    a, b = LogHistogram(), LogHistogram()
+    for i, v in enumerate(values):
+        one.observe(v)
+        (a if i % 2 == 0 else b).observe(v)
+    a.merge(b)
+    assert one.count == a.count == len(values)
+    assert one.zero == a.zero == 2  # 0.0 and -1.0
+    assert one.counts == a.counts  # per-bucket EXACT equality
+    assert one.sum == pytest.approx(a.sum)
+    # Cumulative buckets tally back to the exact count.
+    assert one.buckets()[-1][1] == len(values)
+
+
+def test_histogram_quantile_error_bound():
+    values = sorted([0.013, 0.4, 0.5, 1.7, 3.14, 9.9, 42.0, 123.4])
+    h = LogHistogram()
+    for v in values:
+        h.observe(v)
+    for q in (0.5, 0.95, 0.99, 1.0):
+        rank = max(1, math.ceil(q * len(values)))
+        true = values[rank - 1]
+        est = h.quantile(q)
+        # Upper bound of the sample's bucket: >= the true sample, and over
+        # by at most HIST_BASE - 1 relative (the documented bucket error).
+        assert est >= true * (1 - 1e-9)
+        assert est <= true * HIST_BASE * (1 + 1e-9)
+
+
+def test_histogram_edge_quantiles():
+    h = LogHistogram()
+    assert h.quantile(0.5) is None  # empty => no-data, never a fake zero
+    h.observe(0.0)
+    assert h.quantile(0.5) == 0.0  # zero bucket
+    # An exact power of the base stays in its own bucket (log() noise must
+    # not push base**i into bucket i+1).
+    h2 = LogHistogram()
+    h2.observe(HIST_BASE ** 3)
+    assert h2.quantile(1.0) == pytest.approx(HIST_BASE ** 3, rel=1e-12)
+
+
+def test_snapshot_rejects_unregistered_names():
+    snap = MetricsSnapshot()
+    with pytest.raises(ValueError, match="not a registered"):
+        snap.counter_add("tpusim_typo", 1)
+    with pytest.raises(ValueError, match="not a registered"):
+        snap.observe("tpusim_spans", 1.0)  # registered, but not a histogram
+
+
+# ---------------------------------------------------------------------------
+# Derivation: histogram tallies pinned EXACTLY to independent span tallies.
+
+
+def test_snapshot_tallies_equal_independent_span_tallies():
+    spans = _spans()
+    snap = snapshot_from_spans(spans, now=2000.0)
+
+    # Independent tallies straight off the raw ledger rows.
+    by_name: dict[str, int] = {}
+    for sp in spans:
+        by_name[sp["span"]] = by_name.get(sp["span"], 0) + 1
+
+    assert snap.counters["tpusim_spans"][()] == len(spans)
+    dispatches = by_name["batch"] + by_name["packed_dispatch"]
+    assert snap.merged_hist("tpusim_batch_latency_seconds").count == dispatches
+    assert snap.merged_hist("tpusim_compile_seconds").count == by_name["compile"]
+    saves = snap.merged_hist("tpusim_checkpoint_seconds", (("op", "save"),))
+    loads = snap.merged_hist("tpusim_checkpoint_seconds", (("op", "load"),))
+    assert saves.count == by_name["checkpoint_save"]
+    assert loads.count == by_name["checkpoint_load"]
+    assert snap.counters["tpusim_retries"][()] == by_name["retry"]
+    assert snap.counters["tpusim_fleet_spawns"][()] == by_name["fleet_spawn"]
+    assert snap.counters["tpusim_fleet_requeues"][()] == by_name["fleet_requeue"]
+    assert snap.counters["tpusim_fleet_quarantines"][()] == by_name["fleet_quarantine"]
+    # Runs counter sums the batch attrs; sum tracks durations exactly.
+    assert snap.counters["tpusim_runs"][()] == 2 + 4 + 1
+    batch = snap.merged_hist("tpusim_batch_latency_seconds")
+    assert batch.sum == pytest.approx(0.5 + 1.25 + 3.0)
+    # Requeue rate: 1 requeue / 2 points done (fleet_done fallback).
+    assert snap.gauges["tpusim_requeue_rate"][()] == pytest.approx(0.5)
+    # Newest stats span -> per-stat gauges.
+    rel = snap.gauges["tpusim_stat_rel_halfwidth"]
+    assert rel[(("stat", "revenue"),)] == pytest.approx(0.04)
+    assert rel[(("stat", "orphans"),)] == pytest.approx(0.12)
+
+
+def test_snapshot_folds_perf_rows_and_heartbeats():
+    rows = [
+        perf_row("loadgen", "query_latency_s", 0.8, unit="s",
+                 samples=[0.8, 1.1, 2.0]),
+        perf_row("loadgen", "compiles_per_query", 0.0, unit="count"),
+        perf_row("bench", "query_latency_s", 9.0, unit="s"),  # foreign scenario
+    ]
+    snap = snapshot_from_spans(
+        [], perf_rows=rows, heartbeats=[("w000", 1997.0)], now=2000.0
+    )
+    q = snap.merged_hist("tpusim_query_latency_seconds")
+    assert q.count == 3  # EXACTLY the loadgen samples, never the bench row
+    assert q.sum == pytest.approx(0.8 + 1.1 + 2.0)
+    assert snap.gauges["tpusim_compiles_per_query"][()] == 0.0
+    age = snap.gauges["tpusim_heartbeat_age_seconds"][(("worker", "w000"),)]
+    assert age == pytest.approx(3.0)
+
+
+def test_snapshot_tolerates_foreign_and_partial_spans():
+    spans = [
+        {"span": "batch"},  # no dur_s, no attrs
+        {"span": "batch", "dur_s": None, "attrs": None},
+        {"span": "mystery", "attrs": {"x": 1}},
+        {"span": "stats", "attrs": {}},  # stats span with no per-stat dict
+    ]
+    snap = snapshot_from_spans(spans, now=0.0)
+    assert snap.merged_hist("tpusim_batch_latency_seconds").count == 2
+    assert "tpusim_stat_rel_halfwidth" not in snap.gauges
+
+
+# ---------------------------------------------------------------------------
+# State-dir collectors + derive_state: torn lines, foreign files, missing dir.
+
+
+def test_derive_state_full_dir_exact_cross_check(tmp_path):
+    state = _write_state(tmp_path)
+    snap = derive_state(state, now=2000.0)
+    # Cross-check against an INDEPENDENT tally of the ledger lines.
+    batch_lines = compile_lines = span_lines = 0
+    for path in state.rglob("*.tele.jsonl"):
+        for line in path.read_text().splitlines():
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # the torn line
+            span_lines += 1
+            batch_lines += row["span"] in ("batch", "packed_dispatch")
+            compile_lines += row["span"] == "compile"
+    assert snap.counters["tpusim_spans"][()] == span_lines
+    assert snap.merged_hist("tpusim_batch_latency_seconds").count == batch_lines
+    assert snap.merged_hist("tpusim_compile_seconds").count == compile_lines
+    # Perf ledger folded in; heartbeat age from the NEWEST beat.
+    assert snap.merged_hist("tpusim_query_latency_seconds").count == 3
+    assert snap.gauges["tpusim_compiles_per_query"][()] == 0.0
+    age = snap.gauges["tpusim_heartbeat_age_seconds"][(("worker", "w000"),)]
+    assert age == pytest.approx(3.0)
+    assert snap.meta["source"] == str(state)
+
+
+def test_collectors_tolerate_torn_and_missing(tmp_path):
+    assert collect_heartbeats(tmp_path / "nope") == []
+    assert collect_perf_rows(tmp_path / "nope") == []
+    d = tmp_path / "d"
+    d.mkdir()
+    (d / "w.hb.jsonl").write_text('{"t": 10.0}\n{"t": 12.0\n{"beats": 3}\n')
+    assert collect_heartbeats(d) == [("w", 10.0)]  # torn + t-less skipped
+    (d / "mixed.jsonl").write_text(
+        json.dumps(perf_row("loadgen", "query_latency_s", 1.0, unit="s")) + "\n"
+        + '{"schema": 1, "scenario": "x"}\n'  # schema 1 but invalid row
+        + json.dumps(_mk("batch", 0.0, 0.0, 1.0, "p0")) + "\n"  # telemetry
+        + "{torn"
+    )
+    rows = collect_perf_rows(d)
+    assert len(rows) == 1 and rows[0]["metric"] == "query_latency_s"
+
+
+def test_derive_state_missing_path_is_empty_not_error(tmp_path):
+    snap = derive_state(tmp_path / "never_created")
+    assert snap.counters["tpusim_spans"][()] == 0
+    # And the empty snapshot still renders a valid exposition.
+    assert validate_openmetrics(render_openmetrics(snap)) >= 1
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics rendition + strict validator.
+
+
+def test_render_openmetrics_shape(tmp_path):
+    snap = derive_state(_write_state(tmp_path), now=2000.0)
+    text = render_openmetrics(snap)
+    assert text.splitlines()[-1] == "# EOF"
+    for name, kind, _ in METRICS:
+        assert f"# TYPE {name} {kind}" in text
+    assert f"tpusim_spans_total {snap.counters['tpusim_spans'][()]:g}" in text
+    # Histogram triple with +Inf == _count.
+    assert 'tpusim_batch_latency_seconds_bucket{le="+Inf"} 3' in text
+    assert "tpusim_batch_latency_seconds_count 3" in text
+    assert 'tpusim_checkpoint_seconds_bucket{op="save",le="+Inf"} 1' in text
+    assert validate_openmetrics(text) > 0
+
+
+def test_validator_rejects_malformed_expositions():
+    ok = "# TYPE m counter\nm_total 1\n# EOF"
+    assert validate_openmetrics(ok) == 1
+    with pytest.raises(ValueError, match="EOF"):
+        validate_openmetrics("# TYPE m counter\nm_total 1")
+    with pytest.raises(ValueError, match="undeclared"):
+        validate_openmetrics("other_total 1\n# EOF")
+    with pytest.raises(ValueError, match="_total"):
+        validate_openmetrics("# TYPE m counter\nm 1\n# EOF")
+    with pytest.raises(ValueError, match="bare-named"):
+        validate_openmetrics("# TYPE g gauge\ng_total 1\n# EOF")
+    with pytest.raises(ValueError, match="non-cumulative"):
+        validate_openmetrics(
+            "# TYPE h histogram\n"
+            'h_bucket{le="1"} 5\nh_bucket{le="2"} 3\n'
+            'h_bucket{le="+Inf"} 5\nh_sum 4\nh_count 5\n# EOF'
+        )
+    with pytest.raises(ValueError, match="!= _count"):
+        validate_openmetrics(
+            "# TYPE h histogram\n"
+            'h_bucket{le="+Inf"} 5\nh_sum 4\nh_count 4\n# EOF'
+        )
+    with pytest.raises(ValueError, match="missing"):
+        validate_openmetrics("# TYPE h histogram\nh_count 4\n# EOF")
+
+
+# ---------------------------------------------------------------------------
+# Scrape endpoint: live re-reads, content types, route matrix.
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        return resp.status, resp.headers.get("Content-Type", ""), resp.read().decode()
+
+
+def test_endpoint_routes_against_live_state_dir(tmp_path):
+    state = _write_state(tmp_path)
+    objectives = [Objective(metric="tpusim_spans", op=">=", threshold=1.0)]
+    server = serve_metrics(state, port=0, objectives=objectives)
+    host, port = server.server_address[:2]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        base = f"http://{host}:{port}"
+        status, ctype, body = _get(f"{base}/metrics")
+        assert status == 200 and ctype == CONTENT_TYPE
+        assert validate_openmetrics(body) > 0
+        n0 = int(body.split("tpusim_spans_total ", 1)[1].split("\n", 1)[0])
+
+        # The dir is LIVE: append a span mid-serve, the next scrape sees it
+        # (every request re-derives; torn/appended lines never need locks).
+        with (state / "fleet.tele.jsonl").open("a") as fh:
+            fh.write(json.dumps(_mk("retry", 1100.0, 100.0, 0.0, "psup")) + "\n")
+        _, _, body2 = _get(f"{base}/metrics")
+        n1 = int(body2.split("tpusim_spans_total ", 1)[1].split("\n", 1)[0])
+        assert n1 == n0 + 1
+
+        status, ctype, body = _get(f"{base}/healthz")
+        health = json.loads(body)
+        assert status == 200 and ctype == "application/json"
+        assert health["ok"] and health["ready"] and health["spans"] == n1
+
+        status, _, body = _get(f"{base}/api/summary")
+        summary = json.loads(body)
+        assert status == 200
+        assert summary["counters"]["tpusim_spans"] == n1
+        assert summary["histograms"]["tpusim_batch_latency_seconds"]["count"] == 3
+        assert summary["slo"][0]["status"] == "pass"
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{base}/nope")
+        assert ei.value.code == 404
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_endpoint_tolerates_missing_state_dir(tmp_path):
+    server = serve_metrics(tmp_path / "not_yet", port=0)
+    host, port = server.server_address[:2]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        status, ctype, body = _get(f"http://{host}:{port}/metrics")
+        assert status == 200 and validate_openmetrics(body) >= 1
+        _, _, body = _get(f"http://{host}:{port}/healthz")
+        health = json.loads(body)
+        assert health["ok"] and not health["ready"] and not health["state_dir_exists"]
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_metrics_cli_export_and_once_smoke(tmp_path, capsys):
+    state = _write_state(tmp_path)
+    out = tmp_path / "artifacts" / "m.prom"
+    assert main(["export", str(state), "--out", str(out)]) == 0
+    assert validate_openmetrics(out.read_text()) > 0
+    assert validate_openmetrics(capsys.readouterr().out) > 0
+    assert main(["export", str(tmp_path / "nope")]) == 2
+
+    # --once: bind ephemeral, self-scrape /metrics + /healthz, validate, exit.
+    assert main(["serve", "--state-dir", str(state), "--port", "0", "--once"]) == 0
+    once = capsys.readouterr().out
+    assert "--once scrape OK" in once and "# EOF" in once
+
+
+# ---------------------------------------------------------------------------
+# SLO engine: config loading, evaluation semantics, the full exit matrix.
+
+
+def _snap(tmp_path) -> MetricsSnapshot:
+    return derive_state(_write_state(tmp_path), now=2000.0)
+
+
+def test_load_objectives_json_and_toml(tmp_path):
+    cfg = tmp_path / "slo.json"
+    cfg.write_text(json.dumps({"objectives": [
+        {"name": "b99", "metric": "tpusim_batch_latency_seconds",
+         "stat": "p99", "op": "<=", "threshold": 5.0},
+    ]}))
+    (obj,) = load_objectives(cfg)
+    assert obj.name == "b99" and obj.stat == "p99" and obj.threshold == 5.0
+
+    from tpusim.lint.config import _toml
+
+    if _toml is None:
+        pytest.skip("no TOML parser in this environment")
+    toml_cfg = tmp_path / "slo.toml"
+    toml_cfg.write_text(
+        '[[tool.tpusim-slo.objectives]]\n'
+        'name = "spans"\nmetric = "tpusim_spans"\nop = ">="\nthreshold = 1.0\n'
+    )
+    (obj,) = load_objectives(toml_cfg)
+    assert obj.metric == "tpusim_spans" and obj.op == ">="
+
+
+def test_repo_pyproject_objectives_load_and_reference_registry():
+    # The committed [tool.tpusim-slo] block must parse and only reference
+    # registered families (the JX014 contract, checked live here).
+    names = {name for name, _, _ in METRICS}
+    objectives = load_objectives()
+    assert objectives and all(o.metric in names for o in objectives)
+
+
+def test_load_objectives_structural_errors(tmp_path):
+    with pytest.raises(SloConfigError, match="does not exist"):
+        load_objectives(tmp_path / "missing.json")
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    with pytest.raises(SloConfigError, match="unparseable"):
+        load_objectives(bad)
+    empty = tmp_path / "empty.json"
+    empty.write_text('{"objectives": []}')
+    with pytest.raises(SloConfigError, match="dead gate"):
+        load_objectives(empty)
+    shapes = tmp_path / "shapes.json"
+    for row in ({"metric": "m", "op": "<<", "threshold": 1},
+                {"metric": "m", "threshold": "x"},
+                {"metric": "m", "threshold": 1, "stat": "p42"},
+                {"op": "<=", "threshold": 1}):
+        shapes.write_text(json.dumps({"objectives": [row]}))
+        with pytest.raises(SloConfigError):
+            load_objectives(shapes)
+
+
+def test_evaluate_slos_stats_and_worst_side_gauges(tmp_path):
+    snap = _snap(tmp_path)
+    results = evaluate_slos([
+        Objective(metric="tpusim_batch_latency_seconds", stat="count",
+                  op="==", threshold=3.0),
+        Objective(metric="tpusim_batch_latency_seconds", stat="mean",
+                  op="<=", threshold=2.0),
+        Objective(metric="tpusim_retries", op="<=", threshold=1.0),
+    ], snap)
+    assert [r["status"] for r in results] == ["pass", "pass", "pass"]
+    # Gauge with several labeled series aggregates to the WORST side: a
+    # passing aggregate must imply every series passes.
+    wide = Objective(metric="tpusim_stat_rel_halfwidth", op="<=", threshold=0.05)
+    (r,) = evaluate_slos([wide], snap)
+    assert r["status"] == "violation" and r["observed"] == pytest.approx(0.12)
+    narrow = Objective(metric="tpusim_stat_rel_halfwidth", op="<=",
+                       threshold=0.05, labels=(("stat", "revenue"),))
+    (r,) = evaluate_slos([narrow], snap)
+    assert r["status"] == "pass" and r["observed"] == pytest.approx(0.04)
+
+
+def test_slo_exit_matrix(tmp_path):
+    snap = _snap(tmp_path)
+    passing = [Objective(metric="tpusim_spans", op=">=", threshold=1.0)]
+    violating = [Objective(metric="tpusim_spans", op="<=", threshold=0.0)]
+    unknown = [Objective(metric="tpusim_not_a_metric", op="<=", threshold=1.0)]
+    assert slo_exit_code(evaluate_slos(passing, snap)) == 0
+    assert slo_exit_code(evaluate_slos(violating, snap)) == 1
+    # Structural dominates violation: an unknown metric alongside a
+    # violation still exits 2, never 1.
+    assert slo_exit_code(evaluate_slos(unknown + violating, snap)) == 2
+    (r,) = evaluate_slos(unknown, snap)
+    assert r["status"] == "no-data" and "registry" in r["reason"]
+    # An EMPTY snapshot can never pass green: every objective is no-data.
+    empty = snapshot_from_spans([], now=0.0)
+    assert slo_exit_code(evaluate_slos(
+        [Objective(metric="tpusim_batch_latency_seconds", stat="p99",
+                   op="<=", threshold=1e9)], empty)) == 2
+    # No objectives at all is itself a dead gate.
+    assert slo_exit_code([]) == 2
+
+
+def test_slo_check_cli_exit_matrix(tmp_path, capsys):
+    state = _write_state(tmp_path)
+    cfg = tmp_path / "slo.json"
+    cfg.write_text(json.dumps({"objectives": [
+        {"name": "spans-present", "metric": "tpusim_spans",
+         "op": ">=", "threshold": 1.0},
+    ]}))
+    assert slo_main(["check", str(state), "--config", str(cfg)]) == 0
+    out = capsys.readouterr().out
+    assert "spans-present" in out and "PASS" in out
+
+    cfg.write_text(json.dumps({"objectives": [
+        {"name": "impossible", "metric": "tpusim_spans",
+         "op": "<=", "threshold": 0.0},
+    ]}))
+    assert slo_main(["check", str(state), "--config", str(cfg)]) == 1
+    assert "violation" in capsys.readouterr().err
+
+    # Dead gates, all exit 2: missing state dir, empty-but-existing state
+    # dir (no-data), unparseable config.
+    assert slo_main(["check", str(tmp_path / "gone"), "--config", str(cfg)]) == 2
+    empty_state = tmp_path / "empty_state"
+    empty_state.mkdir()
+    cfg.write_text(json.dumps({"objectives": [
+        {"metric": "tpusim_batch_latency_seconds", "stat": "p99",
+         "op": "<=", "threshold": 1e9},
+    ]}))
+    assert slo_main(["check", str(empty_state), "--config", str(cfg)]) == 2
+    assert "never pass green" in capsys.readouterr().err
+    bad = tmp_path / "bad.json"
+    bad.write_text("{")
+    assert slo_main(["check", str(state), "--config", str(bad)]) == 2
+
+
+# ---------------------------------------------------------------------------
+# Dashboard panels: report and watch render the SAME evaluator's rows.
+
+
+def test_report_and_watch_slo_panels(tmp_path):
+    spans = _spans()
+    objectives = [
+        Objective(name="spans-present", metric="tpusim_spans",
+                  op=">=", threshold=1.0),
+        Objective(name="no-retries", metric="tpusim_retries",
+                  op="<=", threshold=0.0),
+    ]
+    report = render_report(spans, slo=objectives)
+    assert "SLO status" in report
+    assert "spans-present" in report and "VIOLATION" in report
+    watch = render_watch(spans, "src", now=2000.0, slo=objectives)
+    assert "SLO status (VIOLATION)" in watch and "no-retries" in watch
+    # Without objectives, no panel.
+    assert "SLO status" not in render_report(spans)

@@ -19,6 +19,9 @@ Examples:
     python -m tpusim perf compare artifacts/perf/calibration_cpu.jsonl new.jsonl
     python -m tpusim fleet propagation --workers 4 --state-dir fleet/
     python -m tpusim fleet propagation --workers 4 --state-dir fleet/ --resume
+    python -m tpusim metrics export fleet/ --out artifacts/metrics/fleet.prom
+    python -m tpusim metrics serve --state-dir fleet/ --port 9109
+    python -m tpusim slo check fleet/
 
 The ``report`` subcommand (tpusim.report) renders a ``--telemetry`` JSONL
 ledger — or a ``--trace-dir`` XLA trace directory — into a dashboard; the
@@ -235,6 +238,21 @@ def main(argv: list[str] | None = None) -> int:
         from .perf import main as perf_main
 
         return perf_main(argv[1:])
+    if argv and argv[0] == "metrics":
+        # Same dispatch rule. The metrics plane is jax-free by design: the
+        # exporter and the scrape endpoint re-read a live state dir through
+        # the tolerant ledger loaders and must start instantly on a host
+        # with no backend (tpusim.metrics).
+        from .metrics import main as metrics_main
+
+        return metrics_main(argv[1:])
+    if argv and argv[0] == "slo":
+        # Same dispatch rule. `slo check` is the CI gate over the metrics
+        # plane — perf-compare exit discipline (0 pass / 1 violation /
+        # 2 structural-or-dead-gate), no backend import ever.
+        from .metrics import slo_main
+
+        return slo_main(argv[1:])
     if argv and argv[0] == "fleet":
         # Same dispatch rule. The supervisor is jax-free by design — only
         # its subprocess workers initialize a backend, so a wedged device

@@ -150,7 +150,13 @@ _DEFAULT_PACKED_CONSUMERS = ("tpusim/packed.py", "tpusim/flight_export.py")
 #: Packed per-run leaves explicitly declared as dropped at piece boundaries
 #: (escape hatch for leaves that are intentionally not sliced per point).
 _DEFAULT_PACKED_LEAF_STRIP: tuple[str, ...] = ()
-_ALL_RULE_IDS = tuple(f"JX{n:03d}" for n in range(1, 14))
+#: Where the metrics registry literal (``METRICS`` tuple-of-tuples) lives —
+#: JX014's source of truth for the exported metric-family universe.
+_DEFAULT_METRICS_MODULE = "tpusim/metrics.py"
+#: Configs whose SLO objectives (``[tool.tpusim-slo]`` / JSON "objectives")
+#: may only reference registered metric families (JX014).
+_DEFAULT_SLO_CONFIG_FILES = ("pyproject.toml",)
+_ALL_RULE_IDS = tuple(f"JX{n:03d}" for n in range(1, 15))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -183,6 +189,8 @@ class LintConfig:
     packed_leaf_strip: tuple[str, ...] = _DEFAULT_PACKED_LEAF_STRIP
     cli_modules: tuple[str, ...] = _DEFAULT_CLI_MODULES
     flag_ignore: tuple[str, ...] = _DEFAULT_FLAG_IGNORE
+    metrics_module: str = _DEFAULT_METRICS_MODULE
+    slo_config_files: tuple[str, ...] = _DEFAULT_SLO_CONFIG_FILES
 
     def matches(self, rel_path: str, globs: tuple[str, ...]) -> bool:
         rel = rel_path.replace("\\", "/")
@@ -233,9 +241,12 @@ def load_config(pyproject: Path | None = None) -> LintConfig:
         ("packed_leaf_strip", "packed-leaf-strip"),
         ("cli_modules", "cli-modules"),
         ("flag_ignore", "flag-ignore"),
+        ("slo_config_files", "slo-config-files"),
     ):
         if key in block:
             kwargs[field] = tuple(str(v) for v in block[key])
     if "span-writer" in block:
         kwargs["span_writer"] = str(block["span-writer"])
+    if "metrics-module" in block:
+        kwargs["metrics_module"] = str(block["metrics-module"])
     return LintConfig(**kwargs)

@@ -1260,6 +1260,194 @@ class ProjectContracts:
                         lines, col=mflag.start(1),
                     )
 
+    # -- JX014 -------------------------------------------------------------
+    # The metrics/SLO contract: the metrics module's METRICS tuple-of-tuples
+    # literal is the exported-family source of truth. Every metric an SLO
+    # config references must be in it (a typo'd objective is a permanent
+    # rc-2 dead gate), and it must stay in lockstep with the marker-anchored
+    # README metrics table — both directions, like the chaos seam table.
+
+    def _metrics_registry(
+        self,
+    ) -> tuple[ModuleFacts, dict[str, int] | None] | None:
+        """(module facts, family name -> registry-element line) from the
+        metrics module's ``METRICS`` literal; None when the module itself is
+        missing/unparseable, (facts, None) when the literal is."""
+        m = self._load(self.config.metrics_module)
+        if m is None:
+            return None
+        for node in m.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "METRICS"
+                and isinstance(node.value, (ast.Tuple, ast.List))
+            ):
+                out: dict[str, int] = {}
+                for e in node.value.elts:
+                    if isinstance(e, (ast.Tuple, ast.List)) and e.elts:
+                        s = _const_str(e.elts[0])
+                        if s is not None:
+                            out.setdefault(s, e.lineno)
+                return m, (out or None)
+        return m, None
+
+    def _slo_metric_refs(self, rel: str) -> list[tuple[str, int]] | None:
+        """(metric name, line) pairs one SLO config references — JSON
+        ``{"objectives": [...]}`` or TOML ``[tool.tpusim-slo]`` — or None
+        when the file is missing/unparseable/objective-less (structural:
+        the runtime gate would exit 2 on the same config)."""
+        p = self.root / rel
+        try:
+            text = p.read_text()
+        except OSError:
+            return None
+        names: list[str] | None = None
+        if rel.endswith(".json"):
+            try:
+                data = json.loads(text)
+            except json.JSONDecodeError:
+                return None
+            rows = data.get("objectives") if isinstance(data, dict) else None
+        else:
+            from .config import _toml
+
+            if _toml is None:
+                rows = None  # regex fallback keeps the gate armed
+            else:
+                try:
+                    data = _toml.loads(text)
+                except (ValueError, TypeError):
+                    return None
+                rows = (
+                    data.get("tool", {}).get("tpusim-slo", {}).get("objectives")
+                )
+        lines = text.splitlines()
+        if rows is None and not rel.endswith(".json"):
+            out = [
+                (mm.group(1), i)
+                for i, line in enumerate(lines, start=1)
+                for mm in [re.match(
+                    r'\s*(?:"metric"\s*:|metric\s*=)\s*"([^"]+)"', line
+                )]
+                if mm
+            ]
+            return out or None
+        if not isinstance(rows, list) or not rows:
+            return None
+        names = [r.get("metric") for r in rows if isinstance(r, dict)]
+        if not names or not all(isinstance(n, str) for n in names):
+            return None
+        out = []
+        for name in names:
+            lineno = next(
+                (i for i, line in enumerate(lines, start=1)
+                 if f'"{name}"' in line),
+                1,
+            )
+            out.append((name, lineno))
+        return out
+
+    def _readme_metrics(self) -> tuple[dict[str, tuple[str, int]], bool]:
+        """Metric families from the marker-anchored README metrics table:
+        name -> (doc path, line). Same state machine as the seam table."""
+        metrics: dict[str, tuple[str, int]] = {}
+        saw_marker = False
+        for doc in self.config.doc_files:
+            lines = self._doc_lines(doc)
+            armed = in_table = False
+            for i, line in enumerate(lines, start=1):
+                if "tpusim-lint: metrics-table" in line:
+                    saw_marker = armed = True
+                    continue
+                is_row = line.lstrip().startswith("|")
+                if armed and is_row:
+                    armed, in_table = False, True
+                if in_table:
+                    mrow = re.match(r"\s*\|\s*`([A-Za-z0-9_.]+)`\s*\|", line)
+                    if mrow:
+                        metrics.setdefault(mrow.group(1), (doc, i))
+                    elif not is_row:
+                        in_table = False
+        return metrics, saw_marker
+
+    def check_metrics_contract(self) -> Iterator[Finding]:
+        rel = self.config.metrics_module
+        if not rel:
+            return
+        reg = self._metrics_registry()
+        if reg is None:
+            yield Finding(
+                "JX014", rel, 1, 0,
+                "configured metrics-module is missing or unparseable — the "
+                "metrics/SLO contract has no registry to pin (config drift)",
+            )
+            return
+        m, families = reg
+        if families is None:
+            yield m.finding(
+                "JX014", m.tree,
+                "no module-level METRICS tuple-of-tuples literal found — "
+                "the exported metric-family universe must be statically "
+                "readable for the SLO/README cross-check",
+            )
+            return
+        # Direction 1: every SLO-config metric must be a registered family
+        # (an unregistered objective is a permanent no-data rc-2 dead gate).
+        for cfg_rel in self.config.slo_config_files:
+            refs = self._slo_metric_refs(cfg_rel)
+            if refs is None:
+                yield Finding(
+                    "JX014", cfg_rel, 1, 0,
+                    "SLO config is missing, unparseable, or declares no "
+                    "objectives with string `metric` fields — `tpusim slo "
+                    "check` would exit 2 on it (dead gate)",
+                )
+                continue
+            cfg_lines = self._doc_lines(cfg_rel)
+            for name, line in refs:
+                if name not in families:
+                    yield self._doc_finding(
+                        "JX014", cfg_rel, line,
+                        f"SLO objective references metric `{name}` which the "
+                        f"metrics registry ({rel}) never emits — the "
+                        f"objective can only ever evaluate to no-data "
+                        f"(rc 2), never pass",
+                        cfg_lines,
+                    )
+        # Direction 2: registry <-> README metrics table, both ways.
+        documented, saw_marker = self._readme_metrics()
+        if not saw_marker:
+            if self.config.doc_files:
+                doc = self.config.doc_files[0]
+                yield self._doc_finding(
+                    "JX014", doc, 1,
+                    "no `tpusim-lint: metrics-table` marker found in the doc "
+                    "files — the metrics table cannot be cross-checked (add "
+                    "the marker comment above the metric-family table)",
+                    self._doc_lines(doc),
+                )
+            return
+        for fam, line in sorted(families.items()):
+            if fam not in documented:
+                text = m.lines[line - 1].strip() if 0 < line <= len(m.lines) else ""
+                yield Finding(
+                    "JX014", m.path, line, 0,
+                    f"registry metric `{fam}` is missing from the documented "
+                    f"metrics table — an undocumented family no scrape "
+                    f"consumer can discover by contract",
+                    text,
+                )
+        for fam, (doc, line) in sorted(documented.items()):
+            if fam not in families:
+                yield self._doc_finding(
+                    "JX014", doc, line,
+                    f"documented metric `{fam}` is emitted by no registry "
+                    f"family in {rel} — stale table row or renamed metric",
+                    self._doc_lines(doc),
+                )
+
 
 # ---------------------------------------------------------------------------
 # Registry + entry point (mirrors rules.ALL_RULES for the project scope).
@@ -1283,6 +1471,11 @@ CONTRACT_RULES: dict[str, tuple[ContractFn, str]] = {
     "JX013": (
         ProjectContracts.check_cli_flags,
         "README-documented CLI flag no parser declares (docs drift)",
+    ),
+    "JX014": (
+        ProjectContracts.check_metrics_contract,
+        "SLO-config metric absent from the metrics registry, or registry/"
+        "README metrics-table drift",
     ),
 }
 

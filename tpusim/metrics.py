@@ -1,0 +1,1046 @@
+"""``tpusim metrics`` / ``tpusim slo`` — the ledger-derived metrics & SLO plane.
+
+Every observability surface before this module (telemetry spans, tracing,
+perf rows) is a post-hoc file reader. This is the live plane the ROADMAP's
+serve tentpole needs: a derivation layer that folds the telemetry/fleet/
+tracing JSONL ledgers and the perf ledger of a state dir into counters,
+gauges and **mergeable log-bucketed histograms**, an OpenMetrics text
+rendition with a stdlib scrape endpoint, and a declarative SLO engine with
+the perf-compare exit discipline.
+
+    python -m tpusim metrics export fleet/            # OpenMetrics text
+    python -m tpusim metrics serve --state-dir fleet/ --port 9109
+    curl localhost:9109/metrics                        # scrape
+    python -m tpusim slo check fleet/                  # 0 pass / 1 / 2
+
+Deliberately jax-free, like fleet/watch/tracing: the exporter and the SLO
+gate must run on a host with no backend, and the endpoint must start
+instantly next to the simulation it observes. Reading is crash-tolerant the
+way ``tpusim watch`` is — every scrape re-reads the state dir through the
+tolerant ledger loaders (torn trailing lines and not-yet-created files
+contribute zero samples, never an error), so scraping a LIVE fleet is safe
+by construction.
+
+Histograms are log-bucketed with growth factor ``HIST_BASE = 2**(1/8)``:
+bucket ``i`` covers ``(HIST_BASE**(i-1), HIST_BASE**i]``, so a reported
+quantile is the upper bound of its bucket and overestimates the true sample
+quantile by at most ``HIST_BASE - 1`` (< 9.06% relative error); counts are
+EXACT (every observation lands in exactly one bucket — the tests pin
+histogram tallies equal to independently tallied span counts). Two
+histograms merge by adding per-bucket counts, the arXiv:2002.01184
+streaming-estimator discipline: aggregate on-line, mergeably.
+
+The SLO engine evaluates declarative objectives (``[tool.tpusim-slo]`` in
+pyproject.toml, or a JSON file) against a snapshot with ``tpusim slo
+check``'s exit discipline mirroring ``perf compare``: 0 = every objective
+passes, 1 = at least one violation, 2 = structural problem or dead gate (an
+unknown metric name, no objectives, or an objective with NO observed data —
+an empty ledger can never pass green). ``tpusim report`` and ``tpusim
+watch`` render the SAME evaluator's results as their SLO panels, so the
+gate and the dashboards cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import math
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Any, Iterable
+
+__all__ = [
+    "HIST_BASE",
+    "METRICS",
+    "SLO_HEADERS",
+    "LogHistogram",
+    "MetricsSnapshot",
+    "Objective",
+    "SloConfigError",
+    "collect_heartbeats",
+    "collect_perf_rows",
+    "derive_state",
+    "evaluate_slos",
+    "load_objectives",
+    "render_openmetrics",
+    "serve_metrics",
+    "slo_exit_code",
+    "slo_rows",
+    "snapshot_from_spans",
+    "validate_openmetrics",
+    "main",
+    "slo_main",
+]
+
+#: Histogram bucket growth factor. Bucket upper bounds are ``HIST_BASE**i``
+#: over integer ``i`` (sparse — only occupied buckets are stored), so the
+#: relative quantile error is bounded by ``HIST_BASE - 1`` ~ 9.06% and two
+#: histograms built anywhere merge exactly by per-bucket addition.
+HIST_BASE = 2.0 ** 0.125
+
+#: The metric registry: ``(name, type, help)`` per family — the ONE place
+#: the exported metric universe is declared. ``tpusim lint`` (JX014) pins
+#: this tuple against the SLO config's referenced metrics and the README
+#: metrics table, so a renamed family cannot silently strand an objective
+#: or a doc row. Counters are exposed with the OpenMetrics ``_total``
+#: suffix; histogram quantiles carry the documented bucket error above.
+METRICS = (
+    ("tpusim_spans", "counter",
+     "telemetry spans parsed from the state dir"),
+    ("tpusim_runs", "counter",
+     "simulation runs completed (batch/packed_dispatch runs attrs)"),
+    ("tpusim_batch_latency_seconds", "histogram",
+     "batch dispatch wall-clock (batch + packed_dispatch span durations "
+     "— the same broad phase tpusim.tracing attributes)"),
+    ("tpusim_compile_seconds", "histogram",
+     "XLA backend compile time (compile spans)"),
+    ("tpusim_checkpoint_seconds", "histogram",
+     "checkpoint wall-clock by op=save|load (checkpoint_* spans)"),
+    ("tpusim_query_latency_seconds", "histogram",
+     "end-to-end query latency (loadgen perf-ledger samples)"),
+    ("tpusim_retries", "counter",
+     "batch retries (retry spans)"),
+    ("tpusim_fleet_spawns", "counter",
+     "fleet worker spawns (fleet_spawn spans)"),
+    ("tpusim_fleet_requeues", "counter",
+     "fleet point requeues (fleet_requeue spans)"),
+    ("tpusim_fleet_quarantines", "counter",
+     "fleet point quarantines (fleet_quarantine spans)"),
+    ("tpusim_requeue_rate", "gauge",
+     "fleet requeues per completed point"),
+    ("tpusim_compiles_per_query", "gauge",
+     "warmed-path XLA compiles per loadgen query"),
+    ("tpusim_critical_path_seconds", "gauge",
+     "fleet critical-path wall-clock by category (tracing attribution)"),
+    ("tpusim_critical_path_coverage", "gauge",
+     "attributed fraction of the fleet wall-clock window"),
+    ("tpusim_heartbeat_age_seconds", "gauge",
+     "age of each fleet worker's newest heartbeat, by worker"),
+    ("tpusim_stat_rel_halfwidth", "gauge",
+     "per-statistic 95% CI relative half-width (newest stats span)"),
+)
+
+_TYPES = {name: kind for name, kind, _ in METRICS}
+_HELP = {name: text for name, _, text in METRICS}
+
+#: Label-set key: sorted ``(key, value)`` pairs — hashable, order-free.
+Labels = tuple
+
+
+def _labels_key(labels: dict[str, str] | None) -> Labels:
+    return tuple(sorted((str(k), str(v)) for k, v in (labels or {}).items()))
+
+
+class LogHistogram:
+    """A mergeable log-bucketed histogram (sparse ``index -> count``).
+
+    ``observe(v)`` files ``v`` under the smallest integer ``i`` with
+    ``HIST_BASE**i >= v`` (non-positive values under the explicit zero
+    bucket), tracking exact ``count``/``sum``. ``quantile(q)`` reports the
+    upper bound of the bucket holding the q-th sample — an overestimate by
+    at most ``HIST_BASE - 1`` relative. ``merge`` adds per-bucket counts:
+    the result is IDENTICAL to observing both streams into one histogram,
+    which is what makes per-worker histograms foldable into fleet ones.
+    """
+
+    __slots__ = ("counts", "zero", "count", "sum")
+
+    def __init__(self) -> None:
+        self.counts: dict[int, int] = {}
+        self.zero = 0
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self.count += 1
+        self.sum += value
+        if value <= 0.0:
+            self.zero += 1
+            return
+        # The epsilon keeps exact powers of the base in their own bucket
+        # (log() noise must not push base**i into bucket i+1).
+        idx = math.ceil(math.log(value, HIST_BASE) - 1e-9)
+        self.counts[idx] = self.counts.get(idx, 0) + 1
+
+    def merge(self, other: "LogHistogram") -> None:
+        self.zero += other.zero
+        self.count += other.count
+        self.sum += other.sum
+        for idx, n in other.counts.items():
+            self.counts[idx] = self.counts.get(idx, 0) + n
+
+    def quantile(self, q: float) -> float | None:
+        """Upper bound of the bucket holding sample rank ``ceil(q*count)``;
+        None on an empty histogram (no-data, never a fake zero)."""
+        if self.count == 0:
+            return None
+        rank = max(1, math.ceil(min(max(q, 0.0), 1.0) * self.count))
+        seen = self.zero
+        if rank <= seen:
+            return 0.0
+        for idx in sorted(self.counts):
+            seen += self.counts[idx]
+            if rank <= seen:
+                return HIST_BASE ** idx
+        return HIST_BASE ** max(self.counts)  # pragma: no cover - rank<=count
+
+    def buckets(self) -> list[tuple[float, int]]:
+        """Cumulative ``(le, count)`` pairs in ascending ``le`` order, the
+        OpenMetrics ``_bucket`` shape (the +Inf bucket is the renderer's)."""
+        out: list[tuple[float, int]] = []
+        cum = 0
+        if self.zero:
+            cum += self.zero
+            out.append((0.0, cum))
+        for idx in sorted(self.counts):
+            cum += self.counts[idx]
+            out.append((HIST_BASE ** idx, cum))
+        return out
+
+
+@dataclasses.dataclass
+class MetricsSnapshot:
+    """One derived snapshot: per-family series keyed by label set. The
+    constructor-free helpers enforce the registry — a typo'd family name is
+    a programming error here, never a silently invented metric."""
+
+    counters: dict[str, dict[Labels, float]] = dataclasses.field(default_factory=dict)
+    gauges: dict[str, dict[Labels, float]] = dataclasses.field(default_factory=dict)
+    hists: dict[str, dict[Labels, LogHistogram]] = dataclasses.field(default_factory=dict)
+    meta: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def _check(self, name: str, kind: str) -> None:
+        if _TYPES.get(name) != kind:
+            raise ValueError(
+                f"metric {name!r} is not a registered {kind} "
+                f"(registry: {_TYPES.get(name)!r}) — add it to METRICS first"
+            )
+
+    def counter_add(self, name: str, value: float, labels: dict | None = None) -> None:
+        self._check(name, "counter")
+        series = self.counters.setdefault(name, {})
+        key = _labels_key(labels)
+        series[key] = series.get(key, 0.0) + float(value)
+
+    def gauge_set(self, name: str, value: float, labels: dict | None = None) -> None:
+        self._check(name, "gauge")
+        self.gauges.setdefault(name, {})[_labels_key(labels)] = float(value)
+
+    def observe(self, name: str, value: float, labels: dict | None = None) -> None:
+        self._check(name, "histogram")
+        series = self.hists.setdefault(name, {})
+        key = _labels_key(labels)
+        if key not in series:
+            series[key] = LogHistogram()
+        series[key].observe(value)
+
+    def merged_hist(self, name: str, want: Labels = ()) -> LogHistogram:
+        """One histogram over every series of ``name`` whose labels contain
+        ``want`` as a subset — the evaluator's aggregation primitive."""
+        out = LogHistogram()
+        for key, h in (self.hists.get(name) or {}).items():
+            if set(want) <= set(key):
+                out.merge(h)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Derivation: ledgers -> snapshot.
+
+
+def snapshot_from_spans(
+    spans: list[dict],
+    perf_rows: Iterable[dict] = (),
+    heartbeats: Iterable[tuple[str, float]] = (),
+    now: float | None = None,
+) -> MetricsSnapshot:
+    """Fold telemetry/fleet spans (the tolerant ``load_spans`` shape), perf
+    ledger rows and worker heartbeats into one snapshot. Every attr read is
+    ``.get``-based with a None-tolerant default — a torn or foreign ledger
+    contributes zero samples, never a crash (the JX010 dashboard rule)."""
+    if now is None:
+        now = time.time()
+    snap = MetricsSnapshot()
+    snap.counter_add("tpusim_spans", len(spans))
+
+    last_stats: dict | None = None
+    for sp in spans:
+        name = sp.get("span")
+        dur = float(sp.get("dur_s") or 0.0)
+        attrs = sp.get("attrs") or {}
+        if name in ("batch", "packed_dispatch"):
+            # One dispatch histogram across both execution paths — the same
+            # batch/packed_dispatch equivalence tpusim.tracing's broad-phase
+            # attribution uses, so a packed fleet feeds the latency SLO too.
+            snap.observe("tpusim_batch_latency_seconds", dur)
+            snap.counter_add("tpusim_runs", int(attrs.get("runs") or 0))
+        elif name == "compile":
+            snap.observe("tpusim_compile_seconds", dur)
+        elif name == "checkpoint_save":
+            snap.observe("tpusim_checkpoint_seconds", dur, {"op": "save"})
+        elif name == "checkpoint_load":
+            snap.observe("tpusim_checkpoint_seconds", dur, {"op": "load"})
+        elif name == "retry":
+            snap.counter_add("tpusim_retries", 1)
+        elif name == "fleet_spawn":
+            snap.counter_add("tpusim_fleet_spawns", 1)
+        elif name == "fleet_requeue":
+            snap.counter_add("tpusim_fleet_requeues", 1)
+        elif name == "fleet_quarantine":
+            snap.counter_add("tpusim_fleet_quarantines", 1)
+        elif name == "stats":
+            last_stats = attrs
+
+    # Per-stat CI half-widths from the NEWEST stats span — the convergence
+    # state the watch dashboard follows, as scrapeable gauges.
+    if last_stats is not None:
+        per_stat = last_stats.get("stats") or {}
+        for stat, entry in per_stat.items():
+            rel = entry.get("rel_hw_max") if isinstance(entry, dict) else None
+            if isinstance(rel, (int, float)) and not isinstance(rel, bool):
+                snap.gauge_set(
+                    "tpusim_stat_rel_halfwidth", float(rel), {"stat": str(stat)}
+                )
+
+    # Fleet summary -> requeue rate (the same shared extraction both
+    # dashboards render from, so the gauge cannot drift from the panels).
+    from .fleet import summarize_fleet_spans
+
+    fleet = summarize_fleet_spans(spans)
+    if fleet is not None:
+        requeues = len(fleet["requeues"])
+        points = fleet["points_done"]
+        points = int(points) if isinstance(points, (int, float)) else 0
+        snap.gauge_set("tpusim_requeue_rate", requeues / max(points, 1))
+        snap.meta["fleet"] = {
+            "points_done": fleet["points_done"],
+            "points_total": fleet["points_total"],
+            "workers_alive": fleet["workers_alive"],
+            "quarantined": fleet["quarantined"],
+        }
+
+    # Cross-process critical-path attribution (tpusim.tracing): category
+    # seconds + coverage, when the ledgers correlate into a trace.
+    from .tracing import assemble, attribution
+
+    trace = assemble(spans)
+    if trace is not None and any(
+        node.process is not None for node in trace.workers.values()
+    ):
+        att = attribution(trace)
+        for category, seconds in att["categories"].items():
+            snap.gauge_set(
+                "tpusim_critical_path_seconds", seconds,
+                {"category": str(category)},
+            )
+        snap.gauge_set("tpusim_critical_path_coverage", att["coverage"])
+
+    for worker, last_t in heartbeats:
+        snap.gauge_set(
+            "tpusim_heartbeat_age_seconds",
+            max(now - float(last_t), 0.0),
+            {"worker": str(worker)},
+        )
+
+    for row in perf_rows:
+        scenario = row.get("scenario")
+        metric = row.get("metric")
+        if scenario != "loadgen":
+            continue
+        if metric == "query_latency_s":
+            for s in row.get("samples") or []:
+                if isinstance(s, (int, float)) and not isinstance(s, bool):
+                    snap.observe("tpusim_query_latency_seconds", float(s))
+        elif metric == "compiles_per_query":
+            value = row.get("value")
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                snap.gauge_set("tpusim_compiles_per_query", float(value))
+
+    snap.meta.setdefault("derived_at", now)
+    return snap
+
+
+def collect_heartbeats(root: Path) -> list[tuple[str, float]]:
+    """Newest heartbeat timestamp per worker from ``**/*.hb.jsonl`` under
+    ``root`` — tolerant per line (a beat being appended mid-scrape is a
+    torn line, not an error)."""
+    out: list[tuple[str, float]] = []
+    if not root.is_dir():
+        return out
+    for path in sorted(root.rglob("*.hb.jsonl")):
+        last_t: float | None = None
+        try:
+            lines = path.read_text().splitlines()
+        except OSError:
+            continue
+        for line in lines:
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            t = row.get("t") if isinstance(row, dict) else None
+            if isinstance(t, (int, float)) and not isinstance(t, bool):
+                last_t = float(t)
+        if last_t is not None:
+            out.append((path.name[: -len(".hb.jsonl")], last_t))
+    return out
+
+
+def collect_perf_rows(root: Path) -> list[dict]:
+    """Schema-valid perf rows from every ``*.jsonl`` under ``root`` (or the
+    file itself). TOLERANT, unlike ``perf.load_rows``: a live state dir's
+    ledgers are foreign (telemetry spans, heartbeats) or torn mid-append,
+    and a scrape must surface what parses, not die on what doesn't."""
+    from .perf import SCHEMA, validate_row
+
+    files: list[Path]
+    if root.is_dir():
+        files = sorted(root.rglob("*.jsonl"))
+    elif root.exists():
+        files = [root]
+    else:
+        return []
+    rows: list[dict] = []
+    for path in files:
+        try:
+            lines = path.read_text().splitlines()
+        except OSError:
+            continue
+        for line in lines:
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(row, dict) or row.get("schema") != SCHEMA:
+                continue
+            try:
+                validate_row(row)
+            except ValueError:
+                continue
+            rows.append(row)
+    return rows
+
+
+def derive_state(path: str | Path, now: float | None = None) -> MetricsSnapshot:
+    """The one-call derivation behind every surface: state dir (or single
+    ledger file) -> snapshot. A missing path yields an EMPTY snapshot (the
+    endpoint must tolerate a not-yet-created state dir); the SLO dead-gate
+    discipline is what keeps empty from passing green."""
+    from .tracing import collect_spans
+
+    p = Path(path)
+    spans = collect_spans([p])
+    snap = snapshot_from_spans(
+        spans,
+        perf_rows=collect_perf_rows(p),
+        heartbeats=collect_heartbeats(p),
+        now=now,
+    )
+    snap.meta["source"] = str(p)
+    return snap
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics rendition.
+
+
+def _fmt_float(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return format(float(v), ".9g")
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _label_str(key: Labels, extra: str = "") -> str:
+    parts = [f'{k}="{_escape_label(v)}"' for k, v in key]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def render_openmetrics(snap: MetricsSnapshot) -> str:
+    """The snapshot as OpenMetrics text: every registry family gets its
+    ``# TYPE``/``# HELP`` header (absent series render no samples — the SLO
+    evaluator treats that as no-data, never as zero), counters carry the
+    ``_total`` suffix, histograms the cumulative ``_bucket{le=}``/``_sum``/
+    ``_count`` triple, and the exposition ends with ``# EOF``."""
+    out: list[str] = []
+    for name, kind, help_text in METRICS:
+        out.append(f"# TYPE {name} {kind}")
+        out.append(f"# HELP {name} {help_text}")
+        if kind == "counter":
+            series = snap.counters.get(name) or {}
+            for key in sorted(series):
+                out.append(
+                    f"{name}_total{_label_str(key)} "
+                    f"{_fmt_float(series[key])}"
+                )
+        elif kind == "gauge":
+            series_g = snap.gauges.get(name) or {}
+            for key in sorted(series_g):
+                out.append(f"{name}{_label_str(key)} {_fmt_float(series_g[key])}")
+        else:
+            series_h = snap.hists.get(name) or {}
+            for key in sorted(series_h):
+                h = series_h[key]
+                for le, cum in h.buckets():
+                    le_lbl = f'le="{_fmt_float(le)}"'
+                    out.append(f"{name}_bucket{_label_str(key, le_lbl)} {cum}")
+                inf_lbl = 'le="+Inf"'
+                out.append(f"{name}_bucket{_label_str(key, inf_lbl)} {h.count}")
+                out.append(f"{name}_sum{_label_str(key)} {_fmt_float(h.sum)}")
+                out.append(f"{name}_count{_label_str(key)} {h.count}")
+    out.append("# EOF")
+    return "\n".join(out) + "\n"
+
+
+def validate_openmetrics(text: str) -> int:
+    """Strict structural validation of an exposition (the harvest/CI
+    check): declared families only, counters ``_total``-suffixed,
+    histogram buckets cumulative with ``+Inf == _count``, ``# EOF``
+    terminated. Returns the sample-line count; raises ValueError."""
+    lines = text.splitlines()
+    if not lines or lines[-1] != "# EOF":
+        raise ValueError("exposition must end with a '# EOF' line")
+    declared: dict[str, str] = {}
+    samples = 0
+    hist_state: dict[str, dict[str, Any]] = {}
+    for i, line in enumerate(lines[:-1], start=1):
+        if not line:
+            raise ValueError(f"line {i}: blank line inside exposition")
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            fam, _, kind = rest.partition(" ")
+            if kind not in ("counter", "gauge", "histogram"):
+                raise ValueError(f"line {i}: unknown TYPE {kind!r}")
+            declared[fam] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        samples += 1
+        metric_name = line.split("{", 1)[0].split(" ", 1)[0]
+        fam, suffix = metric_name, ""
+        for cand in ("_total", "_bucket", "_sum", "_count"):
+            if metric_name.endswith(cand) and metric_name[: -len(cand)] in declared:
+                fam, suffix = metric_name[: -len(cand)], cand
+                break
+        kind = declared.get(fam)
+        if kind is None:
+            raise ValueError(f"line {i}: sample for undeclared family {metric_name!r}")
+        if kind == "counter" and suffix != "_total":
+            raise ValueError(f"line {i}: counter sample must end in _total")
+        if kind == "gauge" and suffix:
+            raise ValueError(f"line {i}: gauge sample must be bare-named")
+        if kind == "histogram":
+            if suffix not in ("_bucket", "_sum", "_count"):
+                raise ValueError(
+                    f"line {i}: histogram sample needs _bucket/_sum/_count"
+                )
+            value = float(line.rsplit(" ", 1)[1])
+            labels = line.split("{", 1)[1].rsplit("}", 1)[0] if "{" in line else ""
+            series_key = fam + "|" + ",".join(
+                p for p in labels.split(",") if not p.startswith("le=")
+            )
+            st = hist_state.setdefault(
+                series_key, {"prev": -1.0, "inf": None, "count": None}
+            )
+            if suffix == "_bucket":
+                if "le=" not in labels:
+                    raise ValueError(f"line {i}: _bucket sample without le=")
+                if 'le="+Inf"' in labels:
+                    st["inf"] = value
+                elif value < st["prev"]:
+                    raise ValueError(f"line {i}: non-cumulative bucket counts")
+                else:
+                    st["prev"] = value
+            elif suffix == "_count":
+                st["count"] = value
+    for key, st in hist_state.items():
+        if st["inf"] is None or st["count"] is None:
+            raise ValueError(f"histogram series {key}: missing +Inf bucket or _count")
+        if st["inf"] != st["count"]:
+            raise ValueError(
+                f"histogram series {key}: +Inf bucket {st['inf']} != _count {st['count']}"
+            )
+        if st["prev"] > st["inf"]:
+            raise ValueError(f"histogram series {key}: bucket exceeds +Inf")
+    return samples
+
+
+# ---------------------------------------------------------------------------
+# SLO engine.
+
+
+class SloConfigError(ValueError):
+    """A structurally broken SLO config — always exit 2, never a pass."""
+
+
+_OPS = {
+    "<=": lambda v, t: v <= t,
+    ">=": lambda v, t: v >= t,
+    "==": lambda v, t: v == t,
+}
+
+_STATS = ("value", "p50", "p95", "p99", "count", "sum", "mean")
+
+
+@dataclasses.dataclass(frozen=True)
+class Objective:
+    """One declarative objective: ``<metric>[labels] <stat> <op> <threshold>``."""
+
+    metric: str
+    op: str
+    threshold: float
+    stat: str = "value"
+    name: str = ""
+    labels: Labels = ()
+
+    def describe(self) -> str:
+        return self.name or f"{self.metric}.{self.stat}{self.op}{self.threshold:g}"
+
+
+def _objective_from_dict(row: Any, source: str) -> Objective:
+    if not isinstance(row, dict):
+        raise SloConfigError(f"{source}: objective must be an object, got {row!r}")
+    metric = row.get("metric")
+    if not isinstance(metric, str) or not metric:
+        raise SloConfigError(f"{source}: objective needs a string 'metric'")
+    op = row.get("op", "<=")
+    if op not in _OPS:
+        raise SloConfigError(f"{source}: objective op must be one of {sorted(_OPS)}")
+    threshold = row.get("threshold")
+    if not isinstance(threshold, (int, float)) or isinstance(threshold, bool):
+        raise SloConfigError(f"{source}: objective needs a numeric 'threshold'")
+    stat = row.get("stat", "value")
+    if stat not in _STATS:
+        raise SloConfigError(f"{source}: objective stat must be one of {_STATS}")
+    labels = row.get("labels") or {}
+    if not isinstance(labels, dict):
+        raise SloConfigError(f"{source}: objective labels must be an object")
+    return Objective(
+        metric=metric, op=op, threshold=float(threshold), stat=stat,
+        name=str(row.get("name", "")), labels=_labels_key(labels),
+    )
+
+
+def load_objectives(
+    config_path: str | Path | None = None, root: str | Path | None = None
+) -> list[Objective]:
+    """Objectives from an explicit JSON/TOML file, or from the repo's
+    committed ``[tool.tpusim-slo]`` pyproject block (``objectives`` array of
+    tables). Raises :class:`SloConfigError` on anything structural —
+    missing file, no parser, empty/zero objectives — because a gate with no
+    objectives is a dead gate (exit 2), not a vacuous pass."""
+    if config_path is None:
+        pyproject = Path(root) / "pyproject.toml" if root is not None else (
+            Path(__file__).resolve().parents[1] / "pyproject.toml"
+        )
+        config_path = pyproject
+    p = Path(config_path)
+    if not p.exists():
+        raise SloConfigError(f"SLO config {p} does not exist")
+    if p.suffix == ".json":
+        try:
+            data = json.loads(p.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            raise SloConfigError(f"{p}: unparseable JSON SLO config ({e})") from None
+        rows = data.get("objectives") if isinstance(data, dict) else None
+    else:
+        from .lint.config import _toml
+
+        if _toml is None:
+            raise SloConfigError(
+                f"{p}: no TOML parser available (need tomllib/tomli) — pass "
+                f"a JSON config via --config instead"
+            )
+        try:
+            with p.open("rb") as fh:
+                data = _toml.load(fh)
+        except (OSError, ValueError) as e:
+            raise SloConfigError(f"{p}: unparseable TOML ({e})") from None
+        rows = data.get("tool", {}).get("tpusim-slo", {}).get("objectives")
+    if not isinstance(rows, list) or not rows:
+        raise SloConfigError(
+            f"{p}: no SLO objectives found (need a non-empty 'objectives' "
+            f"array) — an objective-less gate is a dead gate"
+        )
+    return [_objective_from_dict(row, str(p)) for row in rows]
+
+
+def _observed(obj: Objective, snap: MetricsSnapshot) -> tuple[float | None, str]:
+    """(observed value, status-reason). None value => no data."""
+    kind = _TYPES.get(obj.metric)
+    if kind is None:
+        return None, "unknown metric (not in the registry)"
+    if kind == "histogram":
+        h = snap.merged_hist(obj.metric, obj.labels)
+        if h.count == 0:
+            return None, "no samples"
+        if obj.stat == "count":
+            return float(h.count), ""
+        if obj.stat == "sum":
+            return h.sum, ""
+        if obj.stat == "mean":
+            return h.sum / h.count, ""
+        if obj.stat in ("p50", "p95", "p99"):
+            return h.quantile(int(obj.stat[1:]) / 100.0), ""
+        return None, f"stat {obj.stat!r} needs a quantile/count/sum on a histogram"
+    series = (snap.counters if kind == "counter" else snap.gauges).get(obj.metric) or {}
+    matched = [v for k, v in series.items() if set(obj.labels) <= set(k)]
+    if not matched:
+        return None, "no samples"
+    if obj.stat != "value":
+        return None, f"stat {obj.stat!r} is histogram-only"
+    if kind == "counter":
+        return float(sum(matched)), ""
+    # Gauge with several matched series: aggregate to the WORST side of the
+    # objective (max for <=/==, min for >=) so a passing aggregate implies
+    # every matched series passes.
+    return (min(matched) if obj.op == ">=" else max(matched)), ""
+
+
+def evaluate_slos(
+    objectives: list[Objective], snap: MetricsSnapshot
+) -> list[dict[str, Any]]:
+    """One result row per objective: status ``pass`` / ``violation`` /
+    ``no-data`` (with a reason). THE shared evaluator: ``slo check`` exits
+    from these rows and both dashboards render them."""
+    results = []
+    for obj in objectives:
+        observed, reason = _observed(obj, snap)
+        if observed is None:
+            status = "no-data"
+        elif _OPS[obj.op](observed, obj.threshold):
+            status = "pass"
+        else:
+            status = "violation"
+        results.append({
+            "objective": obj,
+            "status": status,
+            "observed": observed,
+            "reason": reason,
+        })
+    return results
+
+
+def slo_exit_code(results: list[dict[str, Any]]) -> int:
+    """The perf-compare discipline: structural/no-data dominates (2 — a
+    dead gate must fail loud before a violation is even reported), then
+    violation (1), then pass (0). An empty result list is itself a dead
+    gate."""
+    if not results or any(r["status"] == "no-data" for r in results):
+        return 2
+    if any(r["status"] == "violation" for r in results):
+        return 1
+    return 0
+
+
+SLO_HEADERS = ["objective", "metric", "stat", "target", "observed", "status"]
+
+
+def slo_rows(results: list[dict[str, Any]]) -> list[list[str]]:
+    """Render-ready rows for ``text_table`` — shared by ``slo check``,
+    ``tpusim report`` and ``tpusim watch`` (one source of truth, no
+    drifting twin renderers)."""
+    rows = []
+    for r in results:
+        obj: Objective = r["objective"]
+        observed = r["observed"]
+        status = r["status"].upper()
+        if r["status"] == "no-data" and r["reason"]:
+            status += f" ({r['reason']})"
+        rows.append([
+            obj.describe(),
+            obj.metric + (_label_str(obj.labels) if obj.labels else ""),
+            obj.stat,
+            f"{obj.op} {obj.threshold:g}",
+            f"{observed:g}" if observed is not None else "n/a",
+            status,
+        ])
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoint (stdlib only).
+
+#: OpenMetrics scrape content type (the standard exposition negotiation).
+CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+
+def _summary_payload(snap: MetricsSnapshot, results: list[dict] | None) -> dict:
+    quantiles = {}
+    for name, series in snap.hists.items():
+        h = snap.merged_hist(name)
+        if h.count:
+            quantiles[name] = {
+                "count": h.count,
+                "sum": round(h.sum, 6),
+                "p50": h.quantile(0.5),
+                "p95": h.quantile(0.95),
+                "p99": h.quantile(0.99),
+            }
+    payload: dict[str, Any] = {
+        "counters": {
+            name: sum(series.values())
+            for name, series in snap.counters.items()
+        },
+        "gauges": {
+            name: {",".join(f"{k}={v}" for k, v in key) or "_": value
+                   for key, value in series.items()}
+            for name, series in snap.gauges.items()
+        },
+        "histograms": quantiles,
+        "meta": snap.meta,
+    }
+    if results is not None:
+        payload["slo"] = [
+            {
+                "objective": r["objective"].describe(),
+                "metric": r["objective"].metric,
+                "status": r["status"],
+                "observed": r["observed"],
+            }
+            for r in results
+        ]
+    return payload
+
+
+def serve_metrics(
+    state_dir: str | Path,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    objectives: list[Objective] | None = None,
+):
+    """Build (not start) the scrape server: a stdlib ``ThreadingHTTPServer``
+    whose handler re-derives the snapshot from the state dir ON EVERY
+    request — the watch discipline (torn lines and missing files are
+    tolerated by the loaders underneath), so scraping a live fleet needs no
+    coordination with it. Routes: ``/metrics`` (OpenMetrics), ``/healthz``
+    (liveness + readiness JSON), ``/api/summary`` (JSON digest + SLO
+    status). Returns the server; callers drive ``serve_forever`` and
+    ``shutdown``."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    state = Path(state_dir)
+
+    class Handler(BaseHTTPRequestHandler):
+        def _send(self, code: int, body: bytes, ctype: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
+            path = self.path.split("?", 1)[0]
+            try:
+                if path == "/metrics":
+                    snap = derive_state(state)
+                    self._send(
+                        200, render_openmetrics(snap).encode(), CONTENT_TYPE
+                    )
+                elif path == "/healthz":
+                    snap = derive_state(state)
+                    spans = sum(
+                        (snap.counters.get("tpusim_spans") or {}).values()
+                    )
+                    body = json.dumps({
+                        "ok": True,
+                        "state_dir": str(state),
+                        "state_dir_exists": state.exists(),
+                        "spans": int(spans),
+                        "ready": spans > 0,
+                    }).encode()
+                    self._send(200, body, "application/json")
+                elif path == "/api/summary":
+                    snap = derive_state(state)
+                    results = (
+                        evaluate_slos(objectives, snap)
+                        if objectives else None
+                    )
+                    body = json.dumps(
+                        _summary_payload(snap, results)
+                    ).encode()
+                    self._send(200, body, "application/json")
+                else:
+                    self._send(404, b'{"error": "not found"}', "application/json")
+            except BrokenPipeError:  # scraper hung up mid-response
+                pass
+            except Exception as e:  # noqa: BLE001 - a scrape must never kill the server
+                try:
+                    self._send(
+                        500,
+                        json.dumps({"error": str(e)}).encode(),
+                        "application/json",
+                    )
+                except OSError:
+                    pass
+
+        def log_message(self, *args) -> None:  # quiet by default
+            pass
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+# ---------------------------------------------------------------------------
+# CLI: `tpusim metrics ...` and `tpusim slo ...`.
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpusim metrics",
+        description="Ledger-derived metrics: OpenMetrics export and the "
+        "live scrape endpoint over a telemetry state dir.",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p_exp = sub.add_parser("export", help="render a state dir as OpenMetrics text")
+    p_exp.add_argument("path", type=Path, help="state dir or telemetry .jsonl ledger")
+    p_exp.add_argument("--out", type=Path, help="also write the exposition here")
+
+    p_srv = sub.add_parser("serve", help="HTTP scrape endpoint over a live state dir")
+    p_srv.add_argument(
+        "--state-dir", type=Path, required=True, metavar="DIR",
+        help="state dir (or ledger file) re-read tolerantly on every scrape; "
+        "may not exist yet — /healthz reports ready:false until spans land",
+    )
+    p_srv.add_argument("--host", default="127.0.0.1")
+    p_srv.add_argument(
+        "--port", type=int, default=9109,
+        help="TCP port (0 = ephemeral; the chosen port is printed)",
+    )
+    p_srv.add_argument(
+        "--once", action="store_true",
+        help="bind, self-scrape /metrics + /healthz once (validated), print "
+        "both, and exit — the CI smoke mode",
+    )
+    p_srv.add_argument(
+        "--slo-config", type=Path, metavar="FILE",
+        help="JSON/TOML objectives for /api/summary's SLO status (default: "
+        "the repo pyproject's [tool.tpusim-slo] block, if readable)",
+    )
+    args = ap.parse_args(argv)
+
+    if args.cmd == "export":
+        if not args.path.exists():
+            print(f"error: {args.path} does not exist", file=sys.stderr)
+            return 2
+        text = render_openmetrics(derive_state(args.path))
+        try:
+            print(text, end="")
+        except BrokenPipeError:
+            pass
+        if args.out:
+            args.out.parent.mkdir(parents=True, exist_ok=True)
+            args.out.write_text(text)
+        return 0
+
+    # serve
+    objectives: list[Objective] | None = None
+    try:
+        objectives = load_objectives(args.slo_config)
+    except SloConfigError as e:
+        if args.slo_config is not None:
+            # An EXPLICIT config that does not parse is an error; the
+            # implicit pyproject default is best-effort for /api/summary.
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    server = serve_metrics(
+        args.state_dir, host=args.host, port=args.port, objectives=objectives
+    )
+    host, port = server.server_address[:2]
+    print(f"[metrics] serving {args.state_dir} on http://{host}:{port}/metrics")
+    if args.once:
+        import urllib.request
+
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=30
+            ) as resp:
+                body = resp.read().decode()
+                ctype = resp.headers.get("Content-Type", "")
+            if resp.status != 200 or "openmetrics-text" not in ctype:
+                print(
+                    f"error: /metrics scrape failed (status {resp.status}, "
+                    f"content-type {ctype!r})", file=sys.stderr,
+                )
+                return 1
+            n = validate_openmetrics(body)
+            with urllib.request.urlopen(
+                f"http://{host}:{port}/healthz", timeout=30
+            ) as resp:
+                health = json.loads(resp.read().decode())
+            print(body, end="")
+            print(f"[metrics] --once scrape OK: {n} samples, healthz {health}")
+            return 0
+        except (OSError, ValueError) as e:
+            print(f"error: --once self-scrape failed: {e}", file=sys.stderr)
+            return 1
+        finally:
+            server.shutdown()
+            server.server_close()
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        print()
+    finally:
+        server.server_close()
+    return 0
+
+
+def slo_main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpusim slo",
+        description="Declarative service objectives over the metrics plane "
+        "(exit 0 pass / 1 violation / 2 structural-or-dead-gate).",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_chk = sub.add_parser("check", help="evaluate the objectives against a state dir")
+    p_chk.add_argument("path", type=Path, help="state dir or telemetry .jsonl ledger")
+    p_chk.add_argument(
+        "--config", type=Path, metavar="FILE",
+        help="JSON (.json) or TOML objectives file (default: the repo "
+        "pyproject's [tool.tpusim-slo] block)",
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        objectives = load_objectives(args.config)
+    except SloConfigError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not args.path.exists():
+        print(f"error: {args.path} does not exist (a gate over a missing "
+              f"state dir is a dead gate)", file=sys.stderr)
+        return 2
+    snap = derive_state(args.path)
+    results = evaluate_slos(objectives, snap)
+    from .report import text_table
+
+    print("\n".join(text_table(SLO_HEADERS, slo_rows(results))))
+    rc = slo_exit_code(results)
+    if rc == 2:
+        print(
+            "error: SLO gate is structurally dead (no-data objective or no "
+            "objectives) — an empty ledger can never pass green",
+            file=sys.stderr,
+        )
+    elif rc == 1:
+        n = sum(1 for r in results if r["status"] == "violation")
+        print(f"error: {n} SLO violation(s)", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

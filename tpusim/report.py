@@ -184,8 +184,15 @@ def _batch_aggregates(batches: list[dict]) -> dict[str, Any] | None:
     return agg
 
 
-def render_report(spans: list[dict], fmt: str = "text") -> str:
-    """The dashboard string for one telemetry ledger (``fmt``: text | md)."""
+def render_report(spans: list[dict], fmt: str = "text", slo=None) -> str:
+    """The dashboard string for one telemetry ledger (``fmt``: text | md).
+
+    ``slo`` is an optional list of :class:`tpusim.metrics.Objective`; when
+    given, an "SLO status" panel renders the SAME shared evaluator
+    (``tpusim.metrics.evaluate_slos``) that ``tpusim slo check`` gates on —
+    one source of truth, no drifting twin renderers. The panel is
+    span-scoped (objectives over perf-ledger metrics show NO-DATA here; the
+    gate's full state-dir derivation lives in ``slo check``)."""
     md = fmt == "md"
     out: list[str] = []
 
@@ -573,6 +580,17 @@ def render_report(spans: list[dict], fmt: str = "text") -> str:
             heading("Per-worker utilization")
             table(UTILIZATION_HEADERS, utilization_rows(trace))
 
+    if slo:
+        from .metrics import (
+            SLO_HEADERS,
+            evaluate_slos,
+            slo_rows,
+            snapshot_from_spans,
+        )
+
+        heading("SLO status")
+        table(SLO_HEADERS, slo_rows(evaluate_slos(slo, snapshot_from_spans(spans))))
+
     faults = [sp for sp in spans if sp["span"] == "chaos"]
     if faults:
         # The fault ledger: every injected fault of a chaos drill
@@ -692,8 +710,22 @@ def main(argv: list[str] | None = None) -> int:
         help="trace mode: only sum events whose track name contains this "
         "substring (default: prefer TPU/TensorCore tracks when present)",
     )
+    ap.add_argument(
+        "--slo-config", type=Path, metavar="FILE",
+        help="render an SLO status panel from this JSON/TOML objectives "
+        "config (same evaluator as `tpusim slo check`)",
+    )
     args = ap.parse_args(argv)
 
+    slo = None
+    if args.slo_config is not None:
+        from .metrics import SloConfigError, load_objectives
+
+        try:
+            slo = load_objectives(args.slo_config)
+        except SloConfigError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
     if not args.path.exists():
         print(f"error: {args.path} does not exist", file=sys.stderr)
         return 2
@@ -718,9 +750,9 @@ def main(argv: list[str] | None = None) -> int:
                     f"telemetry ledgers", file=sys.stderr,
                 )
                 return 2
-            text = render_report(spans, fmt=args.format)
+            text = render_report(spans, fmt=args.format, slo=slo)
     else:
-        text = render_report(load_spans(args.path), fmt=args.format)
+        text = render_report(load_spans(args.path), fmt=args.format, slo=slo)
     try:
         print(text, end="", flush=True)
     except BrokenPipeError:

@@ -40,10 +40,17 @@ def _bar(frac: float, width: int = 28) -> str:
     return "[" + "#" * n + "." * (width - n) + "]"
 
 
-def render_watch(spans: list[dict], source: str, now: float | None = None) -> str:
+def render_watch(
+    spans: list[dict], source: str, now: float | None = None, slo=None
+) -> str:
     """One full dashboard frame for the ledger's CURRENT state. Ledgers can
     hold several runs (appended files, sweeps): panels follow the most
-    recent ``run_id``, and the header says how many others there are."""
+    recent ``run_id``, and the header says how many others there are.
+
+    ``slo`` is an optional list of :class:`tpusim.metrics.Objective`; when
+    given, an SLO status panel re-evaluates every frame through the SAME
+    shared evaluator ``tpusim slo check`` gates on (span-scoped here:
+    perf-ledger objectives show NO-DATA in a live frame)."""
     if now is None:
         now = time.time()
     out: list[str] = [f"tpusim watch — {source}"]
@@ -254,6 +261,19 @@ def render_watch(spans: list[dict], source: str, now: float | None = None) -> st
         out.append("convergence: no stats spans yet (run with --telemetry on a "
                    "tpusim version that emits them)")
 
+    # --- SLO status (tpusim.metrics): the declarative objectives, evaluated
+    # live over the frame's spans by the same evaluator `slo check` exits
+    # from — a violation shows here the refresh it happens.
+    if slo:
+        from .metrics import SLO_HEADERS, evaluate_slos, slo_rows, snapshot_from_spans
+
+        results = evaluate_slos(slo, snapshot_from_spans(spans, now=now))
+        worst = ("violation" if any(r["status"] == "violation" for r in results)
+                 else "no-data" if any(r["status"] == "no-data" for r in results)
+                 else "pass")
+        out.append(f"SLO status ({worst.upper()}):")
+        out.extend(text_table(SLO_HEADERS, slo_rows(results)))
+
     # --- Fault ledger.
     faults = [sp for sp in mine if sp["span"] == "chaos"]
     if faults:
@@ -294,7 +314,22 @@ def main(argv: list[str] | None = None) -> int:
         "BEFORE the supervisor/run creates the ledger; --once still exits "
         "rc 2 if the file never appears within the bound",
     )
+    ap.add_argument(
+        "--slo-config", type=Path, metavar="FILE",
+        help="re-evaluate this JSON/TOML objectives config every frame and "
+        "render an SLO status panel (same evaluator as `tpusim slo check`)",
+    )
     args = ap.parse_args(argv)
+
+    slo = None
+    if args.slo_config is not None:
+        from .metrics import SloConfigError, load_objectives
+
+        try:
+            slo = load_objectives(args.slo_config)
+        except SloConfigError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
 
     if args.wait_for_file > 0 and not args.path.exists():
         deadline = time.monotonic() + args.wait_for_file
@@ -306,7 +341,7 @@ def main(argv: list[str] | None = None) -> int:
     try:
         while True:
             spans = load_spans(args.path) if args.path.exists() else []
-            frame = render_watch(spans, str(args.path))
+            frame = render_watch(spans, str(args.path), slo=slo)
             if not args.once and not args.no_clear:
                 sys.stdout.write(_CLEAR)
             try:
