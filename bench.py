@@ -289,7 +289,10 @@ def main() -> int:
         sys.stderr.flush()
         os._exit(1)
 
-    threading.Thread(target=thread_watchdog, daemon=True).start()
+    watchdog = threading.Thread(
+        target=thread_watchdog, daemon=True, name="bench-hard-watchdog"
+    )
+    watchdog.start()
 
     try:
         # --- Phase: backend init with subprocess probes + CPU fallback

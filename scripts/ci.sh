@@ -11,9 +11,12 @@ echo "== tpusim lint =="
 # committed baseline grandfathers old ones. Runs first because it needs no
 # jax import and catches donated-buffer/host-sync/recompile mistakes in
 # seconds, before the expensive legs spin up. The per-module JAX rules
-# (JX001-JX009) AND the cross-module contract pass (JX010-JX014: telemetry
+# (JX001-JX009), the cross-module contract pass (JX010-JX014: telemetry
 # span/attr contracts, chaos seam registry, finalize leaf naming, CLI docs
-# drift, metrics/SLO registry contract) run in this one gate.
+# drift, metrics/SLO registry contract) AND the concurrency pass
+# (JX015-JX019: unsynchronized shared state, thread lifecycle, lock-order
+# conflicts, blocking calls under a lock, fork/signal hazards) run in this
+# one gate.
 python -m tpusim.cli lint --baseline .tpusim-lint-baseline.json
 # Registration floor: the contract passes must actually be REGISTERED *and*
 # ENABLED — a rule-table slip (a deleted registry row, a pyproject
@@ -21,11 +24,11 @@ python -m tpusim.cli lint --baseline .tpusim-lint-baseline.json
 # that greens while checking nothing. --list-rules annotates disabled rules,
 # so the floor counts rules that will actually RUN in the gate above.
 rule_count=$(python -m tpusim.cli lint --list-rules | grep -cv "(disabled)")
-if [ "$rule_count" -lt 14 ]; then
-  echo "lint gate degraded: only $rule_count rules enabled (need >= 14)" >&2
+if [ "$rule_count" -lt 19 ]; then
+  echo "lint gate degraded: only $rule_count rules enabled (need >= 19)" >&2
   exit 1
 fi
-for contract_rule in JX013 JX014; do
+for contract_rule in JX013 JX014 JX015 JX016 JX017 JX018 JX019; do
   python -m tpusim.cli lint --list-rules | grep "^$contract_rule" | grep -qv "(disabled)" \
     || { echo "contract rule $contract_rule missing/disabled in --list-rules" >&2; exit 1; }
 done
@@ -43,6 +46,21 @@ echo "== chaos degradation matrix =="
 # so a chaos regression is named in CI output even when someone runs the
 # pytest leg with a filter.
 env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q -m 'not slow'
+
+echo "== concurrency runtime guard (thread-leak + scrape-under-load) =="
+# The runtime complement of the JX015-JX019 static pass: the fleet
+# supervisor's fake-worker path, the reusable fetch watchdog, and the
+# metrics scrape server each run under tpusim.testing.thread_leak_guard
+# (the `thread_guard` fixture) — every thread the code spawns must be
+# joined or accounted for by exit. The scrape drill additionally hammers
+# /metrics from concurrent scrapers while a writer tears JSONL appends
+# mid-line: every response must be a parseable OpenMetrics 200. Runs as
+# its own leg so a thread leak is named in CI output even when the pytest
+# leg runs filtered.
+env JAX_PLATFORMS=cpu python -m pytest -q \
+  "tests/test_fleet.py::test_fleet_completes_rows_in_point_order" \
+  "tests/test_chaos.py::test_fetch_with_deadline_bounded_watchdog_threads" \
+  "tests/test_metrics.py::test_scrape_under_concurrent_torn_writes"
 
 echo "== chaos drill smoke =="
 # One CLI-surface drill end-to-end: inject a transient dispatch fault via

@@ -26,3 +26,17 @@ jax.config.update("jax_platforms", "cpu")
 from tpusim.compat import set_cpu_device_count  # noqa: E402
 
 set_cpu_device_count(8)
+
+import pytest  # noqa: E402
+
+from tpusim.testing import thread_leak_guard  # noqa: E402
+
+
+@pytest.fixture
+def thread_guard():
+    """Opt-in thread-leak guard (the runtime half of lint JX015-JX019):
+    the test must leave zero new non-daemon threads and at most one new
+    daemon thread — the allowance covers the process-wide reusable fetch
+    watchdog (tpusim.chaos) the first guarded test may lazily spawn."""
+    with thread_leak_guard(max_daemon_delta=1) as census:
+        yield census

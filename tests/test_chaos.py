@@ -18,6 +18,7 @@ import signal
 import subprocess
 import sys
 import threading
+import time
 from pathlib import Path
 
 import numpy as np
@@ -258,6 +259,50 @@ def test_fetch_with_deadline_unit():
             fetch_with_deadline(lambda: release.wait(30.0), 0.05)
     finally:
         release.set()  # unblock the abandoned worker thread
+
+
+def _watchdog_threads():
+    return [
+        t for t in threading.enumerate()
+        if t.name == "tpusim-fetch-watchdog" and t.is_alive()
+    ]
+
+
+def _await_watchdog_count(n, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if len(_watchdog_threads()) <= n:
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"expected <= {n} fetch-watchdog thread(s), have "
+        f"{[t.name for t in _watchdog_threads()]}"
+    )
+
+
+def test_fetch_with_deadline_bounded_watchdog_threads(thread_guard):
+    # The historical bug class: one spawned thread per call. The reusable
+    # worker must serve many calls from ONE daemon thread (thread_guard's
+    # max_daemon_delta=1 allowance IS that worker).
+    for i in range(32):
+        assert fetch_with_deadline(lambda i=i: i * i, 5.0) == i * i
+    assert len(_watchdog_threads()) <= 1
+
+
+def test_fetch_with_deadline_stall_abandons_then_reaps(thread_guard):
+    # A deadline miss abandons the wedged worker; the next call spawns a
+    # fresh one (bounded: at most stalled+1 alive while wedged), and the
+    # abandoned worker retires ON ITS OWN once its fetch unwedges — the
+    # fix for the documented leaked-thread-per-batch caveat.
+    release = threading.Event()
+    with pytest.raises(PipelineStallError, match="watchdog deadline"):
+        fetch_with_deadline(lambda: release.wait(30.0), 0.05)
+    assert fetch_with_deadline(lambda: 11, 5.0) == 11  # service restored
+    assert len(_watchdog_threads()) <= 2  # one wedged + one live, never more
+    release.set()  # unwedge: the abandoned worker must now exit by itself
+    _await_watchdog_count(1)
+    # The stale result was dropped, not delivered to a later caller.
+    assert fetch_with_deadline(lambda: 13, 5.0) == 13
 
 
 # ---------------------------------------------------------------------------

@@ -102,7 +102,10 @@ def plan(*faults: dict) -> ChaosPlan:
 # Supervisor logic (fake workers).
 
 
-def test_fleet_completes_rows_in_point_order(tmp_path):
+def test_fleet_completes_rows_in_point_order(tmp_path, thread_guard):
+    # thread_guard: the supervisor's heartbeat daemon and worker subprocess
+    # plumbing must leave the process thread-clean (lint JX016's runtime
+    # half) — this is also the ci.sh thread-leak leg's target test.
     sup = make_sup(
         tmp_path, fake_points("pt-a", "pt-b", "pt-c"),
         worker_cmd=fake_cmd(),
@@ -129,7 +132,7 @@ def test_fleet_completes_rows_in_point_order(tmp_path):
     assert run["attrs"]["fleet"] is True and run["attrs"]["points_done"] == 3
 
 
-def test_worker_crash_requeued_with_backoff_then_heals(tmp_path):
+def test_worker_crash_requeued_with_backoff_then_heals(tmp_path, thread_guard):
     sup = make_sup(
         tmp_path, fake_points("pt-a", "pt-b"),
         worker_cmd=fake_cmd({"pt-b": "fail-then-ok"}),

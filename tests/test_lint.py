@@ -1422,13 +1422,16 @@ def test_contract_rules_listed_and_registered(capsys):
     pyproject enabled-rules regression shows up here, not just a registry
     slip)."""
     assert set(CONTRACT_RULES) == {"JX010", "JX011", "JX012", "JX013", "JX014"}
+    assert set(CONCURRENCY_RULES) == {
+        "JX015", "JX016", "JX017", "JX018", "JX019",
+    }
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     enabled_lines = [
         ln for ln in out.splitlines() if ln.strip() and "(disabled)" not in ln
     ]
-    assert len(enabled_lines) >= 14
-    for rid in CONTRACT_RULES:
+    assert len(enabled_lines) >= 19
+    for rid in (*CONTRACT_RULES, *CONCURRENCY_RULES):
         assert any(ln.startswith(rid) for ln in enabled_lines)
 
 
@@ -1584,3 +1587,312 @@ def test_cli_github_format(tmp_path, capsys, monkeypatch):
     out = capsys.readouterr().out
     assert out.startswith("::error file=bad.py,line=")
     assert "title=JX001" in out
+
+
+# ---------------------------------------------------------------------------
+# Thread-safety pass (tpusim.lint.concurrency): JX015-JX019 on synthetic
+# projects — one seeded+clean twin per rule — plus the live injected-race
+# gate on the real tree.
+
+from tpusim.lint import CONCURRENCY_RULES, lint_concurrency  # noqa: E402
+
+
+def _thread_proj(tmp_path, src, **over):
+    (tmp_path / "mod.py").write_text(textwrap.dedent(src))
+    base = dict(include=("*.py",), exclude=(), thread_modules=("mod.py",))
+    base.update(over)
+    return LintConfig(**base)
+
+
+def conc_rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def test_jx015_unsynchronized_shared_write_seeded_and_clean(tmp_path):
+    bad = """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.count = 0
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                self.count += 1
+
+            def poll(self):
+                return self.count
+    """
+    cfg = _thread_proj(tmp_path, bad)
+    findings = lint_concurrency(tmp_path, cfg)
+    assert conc_rules_of(findings) == {"JX015"}
+    assert any("Worker.count" in f.message for f in findings)
+    # Clean twin: one lock guarding BOTH sites clears the finding.
+    ok = """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.count = 0
+                self._lock = threading.Lock()
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                with self._lock:
+                    self.count += 1
+
+            def poll(self):
+                with self._lock:
+                    return self.count
+    """
+    assert lint_concurrency(tmp_path, _thread_proj(tmp_path, ok)) == []
+
+
+def test_jx016_lifecycle_seeded_and_clean(tmp_path):
+    bad = """
+        import threading
+
+        def work():
+            pass
+
+        def dropped_handle():
+            threading.Thread(target=work, daemon=True).start()
+
+        def never_joined():
+            runner = threading.Thread(target=work)
+            runner.start()
+
+        def daemon_file_io():
+            def beat():
+                with open("beat.jsonl", "a") as fh:
+                    fh.write("x")
+            t = threading.Thread(target=beat, daemon=True)
+            t.start()
+            t.join()
+    """
+    cfg = _thread_proj(tmp_path, bad)
+    findings = lint_concurrency(tmp_path, cfg)
+    assert conc_rules_of(findings) == {"JX016"}
+    msgs = [f.message for f in findings]
+    assert any("dropped at start()" in m for m in msgs)
+    assert any("never join()ed" in m for m in msgs)
+    assert any("try/except OSError" in m for m in msgs)
+    ok = """
+        import threading
+
+        def work():
+            pass
+
+        def lifecycle_ok():
+            t = threading.Thread(target=work)
+            t.start()
+            t.join()
+
+        def daemon_beat_ok():
+            def beat():
+                try:
+                    with open("beat.jsonl", "a") as fh:
+                        fh.write("x")
+                except OSError:
+                    pass
+            d = threading.Thread(target=beat, daemon=True)
+            d.start()
+    """
+    assert lint_concurrency(tmp_path, _thread_proj(tmp_path, ok)) == []
+
+
+def test_jx017_lock_order_seeded_and_clean(tmp_path):
+    bad = """
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def f():
+            with A:
+                with B:
+                    pass
+
+        def g():
+            with B:
+                with A:
+                    pass
+    """
+    cfg = _thread_proj(tmp_path, bad)
+    findings = lint_concurrency(tmp_path, cfg)
+    assert conc_rules_of(findings) == {"JX017"}
+    assert len(findings) == 1  # one finding per conflicting pair, not four
+    assert "both orders" in findings[0].message
+    ok = """
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def f():
+            with A:
+                with B:
+                    pass
+
+        def g():
+            with A:
+                with B:
+                    pass
+    """
+    assert lint_concurrency(tmp_path, _thread_proj(tmp_path, ok)) == []
+
+
+def test_jx018_blocking_under_lock_seeded_and_clean(tmp_path):
+    bad = """
+        import queue
+        import subprocess
+        import threading
+
+        L = threading.Lock()
+        q = queue.Queue()
+
+        def flush(cmd):
+            with L:
+                subprocess.check_output(cmd)
+
+        def drain():
+            with L:
+                return q.get()
+    """
+    cfg = _thread_proj(tmp_path, bad)
+    findings = lint_concurrency(tmp_path, cfg)
+    assert conc_rules_of(findings) == {"JX018"}
+    msgs = [f.message for f in findings]
+    assert any("subprocess.check_output" in m for m in msgs)
+    assert any("untimed" in m for m in msgs)
+    # Clean twin: blocking work hoisted out of the critical section, and a
+    # TIMED get is bounded — not deadlock fuel.
+    ok = """
+        import queue
+        import subprocess
+        import threading
+
+        L = threading.Lock()
+        q = queue.Queue()
+
+        def flush(cmd):
+            with L:
+                data = list(cmd)
+            subprocess.check_output(data)
+
+        def drain():
+            with L:
+                return q.get(timeout=1.0)
+    """
+    assert lint_concurrency(tmp_path, _thread_proj(tmp_path, ok)) == []
+
+
+def test_jx019_fork_and_signal_seeded_and_clean(tmp_path):
+    bad_spawn = """
+        import subprocess
+        import threading
+
+        def work():
+            subprocess.run(["true"])
+
+        def launch():
+            t = threading.Thread(target=work, daemon=True)
+            t.start()
+    """
+    cfg = _thread_proj(tmp_path, bad_spawn)
+    findings = lint_concurrency(tmp_path, cfg)
+    assert conc_rules_of(findings) == {"JX019"}
+    assert any("thread context" in f.message for f in findings)
+    bad_signal = """
+        import signal
+        import threading
+
+        L = threading.Lock()
+
+        def handler(signum, frame):
+            with L:
+                pass
+
+        signal.signal(signal.SIGTERM, handler)
+    """
+    cfg = _thread_proj(tmp_path, bad_signal)
+    findings = lint_concurrency(tmp_path, cfg)
+    assert conc_rules_of(findings) == {"JX019"}
+    assert any("signal handler" in f.message for f in findings)
+    # Clean twins: subprocess from the MAIN context is the supervisor's
+    # legitimate shape, and an Event.set() handler is async-signal-safe.
+    ok = """
+        import signal
+        import subprocess
+        import threading
+
+        EV = threading.Event()
+
+        def work():
+            pass
+
+        def launch():
+            t = threading.Thread(target=work, daemon=True)
+            t.start()
+
+        def main():
+            subprocess.run(["true"])
+
+        def handler(signum, frame):
+            EV.set()
+
+        signal.signal(signal.SIGTERM, handler)
+    """
+    assert lint_concurrency(tmp_path, _thread_proj(tmp_path, ok)) == []
+
+
+def test_jx015_suppression_comment_is_honored(tmp_path):
+    src = """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.count = 0
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                self.count += 1  # tpusim-lint: disable=JX015 -- test reason
+
+            def poll(self):
+                return self.count
+    """
+    assert lint_concurrency(tmp_path, _thread_proj(tmp_path, src)) == []
+
+
+def test_live_injected_race_fails_the_gate(capsys):
+    """The thread-safety end-to-end on the REAL tree: an unsynchronized
+    shared write injected into fleet.py source must fail `tpusim lint`
+    (exit 1) against the committed EMPTY baseline, and the reverted tree
+    must pass again."""
+    baseline = str(REPO / ".tpusim-lint-baseline.json")
+    fleet = REPO / "tpusim" / "fleet.py"
+    orig = fleet.read_text()
+    try:
+        fleet.write_text(orig + textwrap.dedent("""
+
+            class _InjectedScrapeCache:
+                def __init__(self):
+                    self.rows = 0
+                    self._t = threading.Thread(target=self._pump, daemon=True)
+                    self._t.start()
+
+                def _pump(self):
+                    self.rows += 1
+
+                def snapshot(self):
+                    return self.rows
+        """))
+        assert lint_main(["--baseline", baseline, "--quiet"]) == 1
+        out = capsys.readouterr().out
+        assert "JX015" in out and "_InjectedScrapeCache.rows" in out
+    finally:
+        fleet.write_text(orig)
+    assert lint_main(["--baseline", baseline, "--quiet"]) == 0

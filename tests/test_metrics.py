@@ -12,6 +12,7 @@ from __future__ import annotations
 import json
 import math
 import threading
+import time
 import urllib.request
 from pathlib import Path
 
@@ -410,6 +411,68 @@ def test_endpoint_tolerates_missing_state_dir(tmp_path):
     finally:
         server.shutdown()
         server.server_close()
+
+
+def test_scrape_under_concurrent_torn_writes(tmp_path, thread_guard):
+    """The tolerant-re-read claim exercised under real concurrency: several
+    clients hammer /metrics and /healthz while a writer keeps appending to
+    the live state dir, leaving a torn (newline-less) tail after every row
+    so successive appends glue valid JSON onto garbage — exactly what a
+    worker killed mid-append produces. Every response must be a parseable
+    200; the server must never 500 or serve a half-derived snapshot."""
+    state = _write_state(tmp_path)
+    server = serve_metrics(state, port=0)
+    host, port = server.server_address[:2]
+    srv = threading.Thread(target=server.serve_forever, daemon=True)
+    srv.start()
+    base = f"http://{host}:{port}"
+    stop = threading.Event()
+    errors: list = []
+
+    def writer():
+        led = state / "fleet.tele.jsonl"
+        i = 0
+        while not stop.is_set():
+            with led.open("a") as fh:
+                fh.write(json.dumps(
+                    _mk("retry", 1200.0 + i, 200.0 + i, 0.0, "psup")) + "\n")
+                fh.write('{"span": "batch", "t_start": 12')  # torn tail
+            i += 1
+            time.sleep(0.001)
+
+    def scraper(k):
+        try:
+            for j in range(15):
+                status, ctype, body = _get(f"{base}/metrics")
+                if status != 200 or ctype != CONTENT_TYPE:
+                    errors.append((k, j, "metrics", status, ctype))
+                    return
+                validate_openmetrics(body)  # raises on a torn exposition
+                status, _, body = _get(f"{base}/healthz")
+                if status != 200 or not json.loads(body)["ok"]:
+                    errors.append((k, j, "healthz", status, body[:200]))
+                    return
+        except Exception as e:  # noqa: BLE001 — an HTTPError(500) lands here
+            errors.append((k, "exception", repr(e)))
+
+    w = threading.Thread(target=writer, name="torn-writer")
+    scrapers = [
+        threading.Thread(target=scraper, args=(k,), name=f"scraper-{k}")
+        for k in range(4)
+    ]
+    w.start()
+    try:
+        for t in scrapers:
+            t.start()
+        for t in scrapers:
+            t.join(timeout=120)
+    finally:
+        stop.set()
+        w.join(timeout=30)
+        server.shutdown()
+        server.server_close()
+    assert not errors, errors
+    assert not w.is_alive() and not any(t.is_alive() for t in scrapers)
 
 
 def test_metrics_cli_export_and_once_smoke(tmp_path, capsys):

@@ -272,36 +272,82 @@ def as_injector(chaos) -> ChaosInjector | None:
     return ChaosInjector(load_plan(chaos))
 
 
+class _FetchWorker:
+    """The process-wide reusable fetch-watchdog thread.
+
+    One daemon thread pulls (thunk, reply-queue) tasks off ``tasks`` and
+    runs them. When a deadline expires the *caller* marks the worker
+    ``abandoned`` and stops routing work to it: the wedged thread cannot be
+    cancelled, but it exits on its own the moment the stuck fetch unwedges
+    (the sentinel ``None`` task covers the raced-but-not-wedged case), and
+    the stale result is dropped instead of being delivered to a caller that
+    long since re-dispatched synchronously.
+    """
+
+    def __init__(self) -> None:
+        self.tasks: queue.Queue = queue.Queue()
+        self.abandoned = False
+        self.thread = threading.Thread(
+            target=self._loop, daemon=True, name="tpusim-fetch-watchdog"
+        )
+        self.thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            task = self.tasks.get()
+            if task is None:  # abandonment sentinel: retire quietly
+                return
+            thunk, out = task
+            try:
+                result = (True, thunk())
+            except BaseException as e:  # noqa: BLE001 — relayed to the caller
+                result = (False, e)
+            if self.abandoned:
+                return  # stale result; the caller already gave up on us
+            out.put(result)
+
+
+#: Current reusable watchdog, lazily (re)spawned; the lock only guards the
+#: handoff — no blocking work ever runs under it (JX018).
+_fetch_worker: _FetchWorker | None = None
+_fetch_worker_lock = threading.Lock()
+
+
 def fetch_with_deadline(thunk, timeout_s: float, what: str = "done-flag fetch"):
     """Run a blocking device fetch with a wall-clock watchdog.
 
     The tunneled TPU backend can wedge a transfer inside C land where no
     signal-based timeout fires (the same failure mode tpusim.probe exists
-    for, here striking mid-pipeline). The fetch therefore runs on a daemon
-    thread; if it outlives ``timeout_s`` a :class:`PipelineStallError` is
-    raised and the thread is abandoned — it cannot be cancelled, but the
-    caller's degradation path (synchronous re-dispatch) no longer depends
-    on it. Results/exceptions from a fetch that completes in time are
-    returned/re-raised unchanged.
+    for, here striking mid-pipeline). The fetch therefore runs on a shared
+    daemon worker thread; if it outlives ``timeout_s`` a
+    :class:`PipelineStallError` is raised and the worker is abandoned — it
+    cannot be cancelled, but it retires itself as soon as the stuck fetch
+    unwedges, and the next call spawns a fresh worker. Results/exceptions
+    from a fetch that completes in time are returned/re-raised unchanged.
 
-    Cost: one short-lived thread + queue per call. The pipelined loop
-    fetches once per multi-second chunk, so the ~50 us spawn is noise, and
-    at most ONE thread can leak per batch — the first stall aborts the
-    pipelined loop (run_batch degrades to a synchronous re-dispatch), so a
-    wedged tunnel never accumulates a blocked thread per chunk.
+    Cost: ONE persistent daemon thread reused across calls (the pipelined
+    loop fetches once per multi-second chunk, serialized by construction).
+    The thread population is bounded: steady state is a single idle worker;
+    each stall leaves at most one abandoned worker alive only while its
+    fetch stays wedged. Concurrent callers are serialized through the one
+    worker — acceptable while the only client is the single pipelined
+    dispatch loop per process.
     """
+    global _fetch_worker
+    with _fetch_worker_lock:
+        if _fetch_worker is None or not _fetch_worker.thread.is_alive():
+            _fetch_worker = _FetchWorker()
+        worker = _fetch_worker
     out: queue.Queue = queue.Queue(maxsize=1)
-
-    def worker() -> None:
-        try:
-            out.put((True, thunk()))
-        except BaseException as e:  # noqa: BLE001 — relayed to the caller
-            out.put((False, e))
-
-    threading.Thread(target=worker, daemon=True, name="tpusim-fetch-watchdog").start()
+    worker.tasks.put((thunk, out))
     try:
         ok, value = out.get(timeout=timeout_s)
     except queue.Empty:
+        with _fetch_worker_lock:
+            worker.abandoned = True
+            worker.tasks.put(None)  # unblocks a raced (not wedged) worker
+            if _fetch_worker is worker:
+                _fetch_worker = None
         raise PipelineStallError(
             f"{what} exceeded the {timeout_s:.1f}s wall-clock watchdog deadline"
         ) from None

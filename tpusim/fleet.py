@@ -177,7 +177,9 @@ class _Heartbeat:
         row = {"t": round(time.time(), 3), "beats": self._beats, **self._state}
         with self.path.open("a") as fh:
             fh.write(json.dumps(row) + "\n")
-        self._beats += 1
+        # Single-writer by construction: start() beats once BEFORE the
+        # thread exists; afterwards only the beat thread calls _write.
+        self._beats += 1  # tpusim-lint: disable=JX015 -- handoff precedes start()
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):

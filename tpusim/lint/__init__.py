@@ -40,6 +40,22 @@ telemetry/chaos/fleet/packed PRs grew that no per-module rule can see:
          its combine_sums merge and its runner strip/checkpoint fate
   JX013  CLI docs drift: a README-documented ``--flag`` no parser declares
 
+A third, whole-project *thread-safety* pass (tpusim.lint.concurrency) gates
+the repo's thread populations (fleet heartbeat, chaos watchdog, metrics
+HTTP server, bench hard watchdog) ahead of the ``tpusim serve`` daemon:
+
+  JX015  unsynchronized shared state: written in a thread body (or any
+         function reachable from one), touched from another context, no
+         common lock held at both sites
+  JX016  thread lifecycle: non-daemon threads never joined, dropped
+         ``start()`` handles, daemon file I/O without the beat-retry
+         ``except OSError`` guard
+  JX017  inconsistent nested lock ordering across the module set (deadlock)
+  JX018  blocking call (device dispatch, subprocess wait, socket accept,
+         untimed ``queue.get``) inside a held-lock region
+  JX019  fork/subprocess from thread context; non-async-signal-safe work
+         in ``signal.signal`` handlers
+
 Suppression: append ``# tpusim-lint: disable=JX002 -- reason`` to the
 offending line (or put the comment alone on the line above). A committed
 baseline file grandfathers pre-existing findings; the CI gate fails only on
@@ -50,6 +66,7 @@ from __future__ import annotations
 
 from .analysis import ModuleAnalysis
 from .baseline import Baseline
+from .concurrency import CONCURRENCY_RULES, lint_concurrency
 from .config import LintConfig, load_config
 from .contracts import CONTRACT_RULES, lint_contracts
 from .findings import Finding
@@ -57,11 +74,13 @@ from .rules import ALL_RULES, lint_paths, lint_source
 
 __all__ = [
     "ALL_RULES",
+    "CONCURRENCY_RULES",
     "CONTRACT_RULES",
     "Baseline",
     "Finding",
     "LintConfig",
     "ModuleAnalysis",
+    "lint_concurrency",
     "lint_contracts",
     "lint_paths",
     "lint_source",

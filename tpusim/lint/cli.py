@@ -18,6 +18,7 @@ import sys
 from pathlib import Path
 
 from .baseline import Baseline
+from .concurrency import CONCURRENCY_RULES, lint_concurrency
 from .config import load_config
 from .contracts import CONTRACT_RULES, lint_contracts
 from .rules import ALL_RULES, lint_paths
@@ -119,6 +120,7 @@ def main(argv: list[str] | None = None) -> int:
         table = {
             **{rid: desc for rid, (_, desc) in ALL_RULES.items()},
             **{rid: desc for rid, (_, desc) in CONTRACT_RULES.items()},
+            **{rid: desc for rid, (_, desc) in CONCURRENCY_RULES.items()},
         }
         enabled = {r.upper() for r in config.enabled_rules}
         for rule_id in sorted(table):
@@ -129,7 +131,10 @@ def main(argv: list[str] | None = None) -> int:
     if args.rules:
         rules = [r.strip().upper() for r in args.rules.split(",") if r.strip()]
         unknown = [
-            r for r in rules if r not in ALL_RULES and r not in CONTRACT_RULES
+            r for r in rules
+            if r not in ALL_RULES
+            and r not in CONTRACT_RULES
+            and r not in CONCURRENCY_RULES
         ]
         if unknown:
             print(f"error: unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
@@ -149,6 +154,13 @@ def main(argv: list[str] | None = None) -> int:
     wants_contracts = any(r in CONTRACT_RULES for r in enabled)
     if wants_contracts and (not args.paths or rules is not None):
         findings.extend(lint_contracts(root, config=config, rules=enabled))
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    # The thread-safety pass (JX015-JX019) is whole-project for the same
+    # reason: lock-ordering conflicts span modules, and the thread-modules
+    # set comes from config, not the path arguments.
+    wants_concurrency = any(r in CONCURRENCY_RULES for r in enabled)
+    if wants_concurrency and (not args.paths or rules is not None):
+        findings.extend(lint_concurrency(root, config=config, rules=enabled))
         findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
 
     if args.write_baseline:

@@ -1,0 +1,781 @@
+"""Interprocedural thread-safety pass: JX015-JX019.
+
+The per-module rules (tpusim.lint.rules) pin JAX/device hygiene and the
+contract pass (tpusim.lint.contracts) pins the stringly-typed protocols;
+this pass pins the repo's *thread populations* — the fleet heartbeat
+daemon, the chaos fetch watchdog, the metrics ThreadingHTTPServer and the
+bench hard-watchdog — before the `tpusim serve` daemon multiplies them:
+
+  JX015  unsynchronized shared state — an attribute or module global
+         written inside a ``threading.Thread(target=...)`` body (or any
+         function reachable from one) that is also read or written from
+         another execution context with no common lock held at both sites.
+         ``with <lock>:`` regions are tracked as dataflow (lock attrs by
+         configured name, plus any name assigned from ``threading.Lock()``).
+  JX016  thread lifecycle discipline — a non-daemon thread no path ever
+         ``join()``s, a ``Thread(...).start()`` whose handle is dropped on
+         the floor (unjoinable, unreapable), and a daemon thread whose body
+         touches files without the beat-retry ``try/except OSError``
+         pattern fleet._Heartbeat established (a daemon dies with the
+         process; an unhandled late-write OSError kills it early and
+         silently).
+  JX017  lock-ordering — two locks acquired nested in both orders anywhere
+         across the scanned module set: the classic deadlock lint.
+  JX018  blocking call under lock — device dispatch (JX002's device-call
+         patterns), subprocess waits, socket accepts, sleeps, and untimed
+         ``queue.get()`` inside a held-lock region.
+  JX019  fork-after-threads / signal-handler safety — ``subprocess`` or
+         ``os.fork`` spawns from *thread context* (the forked child
+         inherits whatever locks other threads held — instant deadlock),
+         ``os.fork`` anywhere in a module that starts threads, and
+         non-async-signal-safe work (lock acquisition, queue ops, joins)
+         reachable from a ``signal.signal`` handler.
+
+Like the contract pass this is whole-project, AST/text only and jax-free:
+it reads ``thread-modules`` from ``[tool.tpusim-lint]``, only runs on the
+full-walk CLI invocation, honors ``# tpusim-lint: disable=`` comments and
+rides the same baseline fingerprints. The analysis is deliberately shallow
+where shallow is sound (one- and two-level call chains, module-local lock
+identity) and conservative where the bug class is silent — a false
+positive here costs one reasoned suppression; a missed data race costs a
+wedged serve daemon at 3am.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Callable, Iterator
+
+from .analysis import dotted_name
+from .config import LintConfig
+from .contracts import ModuleFacts
+from .findings import Finding
+
+#: Callable dotted names recognized as thread constructors.
+_THREAD_CTORS = frozenset({"threading.Thread", "Thread"})
+
+#: ... as lock constructors (JX015/JX017/JX018 lock identity).
+_LOCK_CTORS = frozenset({
+    "threading.Lock", "threading.RLock", "Lock", "RLock",
+    "threading.Condition", "Condition",
+})
+
+#: ... as queue constructors (the untimed-get arm of JX018).
+_QUEUE_CTORS = frozenset({
+    "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue", "Queue", "SimpleQueue",
+})
+
+#: Process-spawn call names for JX019.
+_SPAWN_CALLS = frozenset({
+    "subprocess.run", "subprocess.Popen", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output", "os.system",
+    "os.fork", "os.forkpty", "os.posix_spawn", "os.spawnv", "os.spawnvp",
+})
+
+#: True fork (no exec) — undefined behavior after threads exist at all.
+_FORK_CALLS = frozenset({"os.fork", "os.forkpty"})
+
+#: File-touching call leaves a daemon-thread body must wrap in the
+#: beat-retry pattern (JX016): ``try: <write> except OSError: continue``.
+_FILE_OP_LEAVES = frozenset({
+    "open", "write_text", "write_bytes", "append_jsonl_line",
+})
+_FILE_OP_DOTTED = frozenset({
+    "os.replace", "os.rename", "os.remove", "os.unlink", "os.makedirs",
+})
+
+#: Exception names that count as catching an OSError (the beat-retry arm).
+_OSERROR_CATCHERS = frozenset({
+    "OSError", "IOError", "Exception", "BaseException",
+})
+
+
+def _leaf(dotted: str | None) -> str | None:
+    return dotted.rsplit(".", 1)[-1] if dotted else None
+
+
+def _const_bool(node: ast.AST | None) -> bool | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, bool):
+        return node.value
+    return None
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    """Timed variants of .wait()/.get() are bounded, not deadlock fuel."""
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return True
+    return any(
+        isinstance(a, ast.Constant) and isinstance(a.value, (int, float))
+        for a in call.args
+    )
+
+
+class _Func:
+    """One function (or method, or nested def) in the scope tree."""
+
+    __slots__ = ("key", "node", "cls", "parent", "children", "globals")
+
+    def __init__(self, key, node, cls, parent):
+        self.key = key
+        self.node = node
+        self.cls = cls          # owning class name, "" for plain functions
+        self.parent = parent    # enclosing function key, None at module level
+        self.children: dict[str, str] = {}  # local def name -> key
+        self.globals: set[str] = {
+            n for stmt in ast.walk(node)
+            if isinstance(stmt, ast.Global) for n in stmt.names
+        }
+
+
+class _Access:
+    """One attribute/global access or call site, with the lock state."""
+
+    __slots__ = ("node", "held", "protected")
+
+    def __init__(self, node, held, protected):
+        self.node = node
+        self.held = held            # frozenset of canonical lock ids
+        self.protected = protected  # inside try/except-OSError in this func
+
+
+class _Spawn:
+    """One ``threading.Thread(...)`` construction site."""
+
+    __slots__ = ("node", "scope", "target_key", "target_leaf", "daemon",
+                 "handles", "binding", "name")
+
+    def __init__(self, node, scope):
+        self.node = node
+        self.scope = scope
+        self.target_key: str | None = None
+        self.target_leaf: str | None = None
+        self.daemon: bool | None = None
+        self.handles: list[str] = []   # canonical ids the handle is bound to
+        self.binding = "escaped"       # bound | dropped-start | dropped | escaped
+        self.name: str | None = None
+
+
+class _ModuleThreads:
+    """Per-module thread/lock facts: scope tree, call graph, spawns,
+    lock-annotated accesses, and the thread-context reachability closure."""
+
+    def __init__(self, facts: ModuleFacts, config: LintConfig):
+        self.facts = facts
+        self.config = config
+        self.funcs: dict[str, _Func] = {}
+        self.top: dict[str, str] = {}       # module-level def name -> key
+        self.edges: dict[str | None, set[str]] = {}
+        self.locks: set[str] = set()        # canonical ids assigned Lock()
+        self.queues: set[str] = set()       # canonical ids assigned Queue()
+        self.spawns: list[_Spawn] = []
+        self.joins: set[str] = set()        # canonical join() receivers
+        self.daemon_sets: set[str] = set()  # handles with `X.daemon = True`
+        #: scope key (None = module level) -> collected accesses
+        self.attr_loads: dict[str | None, list[tuple[tuple, _Access]]] = {}
+        self.attr_stores: dict[str | None, list[tuple[tuple, _Access]]] = {}
+        self.calls: dict[str | None, list[tuple[str | None, _Access]]] = {}
+        self.lock_enters: dict[str | None, list[tuple[str, ast.AST]]] = {}
+        self.order_edges: list[tuple[str, str, ast.AST]] = []
+        self.signal_handlers: list[tuple[str, ast.AST]] = []
+        self._parents: dict[ast.AST, ast.AST] = {}
+        for n in ast.walk(facts.tree):
+            for c in ast.iter_child_nodes(n):
+                self._parents[c] = n
+        self._index(facts.tree.body, cls="", parent=None)
+        self._collect_lock_assigns()
+        for key in [None, *self.funcs]:
+            self._scan(key)
+        self._collect_spawns()
+        self.thread_reach = self._closure(
+            {s.target_key for s in self.spawns if s.target_key}
+        )
+        # "Other execution context": the module level plus everything
+        # reachable from a function that is NOT thread-only. __init__ is
+        # exempt — publication-before-start is the safe idiom.
+        other_seeds = {
+            k for k in self.funcs
+            if k not in self.thread_reach and _leaf(k) != "__init__"
+        }
+        self.other_reach = self._closure(other_seeds)
+
+    # -- scope tree -------------------------------------------------------
+
+    def _index(self, body, cls, parent):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if parent is not None:
+                    key = f"{parent}.{node.name}"
+                elif cls:
+                    key = f"{cls}.{node.name}"
+                else:
+                    key = node.name
+                f = _Func(key, node, cls, parent)
+                self.funcs[key] = f
+                if parent is not None:
+                    self.funcs[parent].children[node.name] = key
+                else:
+                    self.top.setdefault(node.name, key)
+                self._index(node.body, cls=cls, parent=key)
+            elif isinstance(node, ast.ClassDef) and parent is None and not cls:
+                self._index(node.body, cls=node.name, parent=None)
+
+    def _resolve(self, expr: ast.AST, scope: str | None) -> str | None:
+        """A callable reference -> function key, via the lexical chain."""
+        if isinstance(expr, ast.Name):
+            k = scope
+            while k is not None:
+                f = self.funcs[k]
+                if expr.id in f.children:
+                    return f.children[expr.id]
+                k = f.parent
+            return self.top.get(expr.id)
+        d = dotted_name(expr)
+        if d and d.startswith("self.") and "." not in d[5:]:
+            cls = self.funcs[scope].cls if scope else ""
+            if cls and f"{cls}.{d[5:]}" in self.funcs:
+                return f"{cls}.{d[5:]}"
+        return None
+
+    def _canon(self, expr: ast.AST, scope: str | None) -> str | None:
+        """Canonical dotted id: ``self._lock`` in class C -> ``C._lock``."""
+        d = dotted_name(expr)
+        if d is None:
+            return None
+        if d.startswith("self."):
+            cls = self.funcs[scope].cls if scope else ""
+            if cls:
+                return f"{cls}.{d[5:]}"
+        return d
+
+    def _closure(self, seeds: set[str]) -> set[str]:
+        out, work = set(seeds), list(seeds)
+        while work:
+            for callee in self.edges.get(work.pop(), ()):
+                if callee not in out:
+                    out.add(callee)
+                    work.append(callee)
+        return out
+
+    # -- fact collection --------------------------------------------------
+
+    def _collect_lock_assigns(self):
+        for node in ast.walk(self.facts.tree):
+            if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call
+            ):
+                continue
+            ctor = dotted_name(node.value.func)
+            scope = self._enclosing_scope(node)
+            for tgt in node.targets:
+                cid = self._canon(tgt, scope)
+                if cid is None:
+                    continue
+                if ctor in _LOCK_CTORS:
+                    self.locks.add(cid)
+                elif ctor in _QUEUE_CTORS:
+                    self.queues.add(cid)
+
+    def _enclosing_scope(self, node: ast.AST) -> str | None:
+        n = self._parents.get(node)
+        while n is not None:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for key, f in self.funcs.items():
+                    if f.node is n:
+                        return key
+            n = self._parents.get(n)
+        return None
+
+    def _lock_id(self, expr: ast.AST, scope: str | None) -> str | None:
+        cid = self._canon(expr, scope)
+        if cid is None:
+            return None
+        if _leaf(cid) in self.config.lock_attr_names or cid in self.locks:
+            return cid
+        return None
+
+    def _scan(self, key: str | None):
+        self.attr_loads[key] = []
+        self.attr_stores[key] = []
+        self.calls[key] = []
+        self.lock_enters[key] = []
+        self.edges[key] = set()
+        if key is None:
+            body = [
+                n for n in self.facts.tree.body
+                if not isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                )
+            ]
+        else:
+            body = self.funcs[key].node.body
+        for stmt in body:
+            self._scan_node(stmt, key, frozenset(), False)
+
+    def _scan_node(self, node, key, held, protected):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # separate scope; its own _scan pass covers it
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = set(held)
+            for item in node.items:
+                self._scan_node(item.context_expr, key, held, protected)
+                lid = self._lock_id(item.context_expr, key)
+                if lid is not None:
+                    for h in inner:
+                        if h != lid:
+                            self.order_edges.append((h, lid, item.context_expr))
+                    inner.add(lid)
+                    self.lock_enters[key].append((lid, item.context_expr))
+            for stmt in node.body:
+                self._scan_node(stmt, key, frozenset(inner), protected)
+            return
+        if isinstance(node, ast.Try):
+            catches = any(
+                h.type is None
+                or _leaf(dotted_name(h.type)) in _OSERROR_CATCHERS
+                or (isinstance(h.type, ast.Tuple) and any(
+                    _leaf(dotted_name(e)) in _OSERROR_CATCHERS
+                    for e in h.type.elts))
+                for h in node.handlers
+            )
+            for stmt in node.body:
+                self._scan_node(stmt, key, held, protected or catches)
+            for part in (*node.handlers, *node.orelse, *node.finalbody):
+                self._scan_node(part, key, held, protected)
+            return
+        self._visit(node, key, held, protected)
+        for child in ast.iter_child_nodes(node):
+            self._scan_node(child, key, held, protected)
+
+    def _visit(self, node, key, held, protected):
+        acc = lambda: _Access(node, held, protected)  # noqa: E731
+        if isinstance(node, ast.Attribute) and isinstance(
+            node.value, ast.Name
+        ) and node.value.id == "self":
+            cls = self.funcs[key].cls if key else ""
+            if cls:
+                bucket = (
+                    self.attr_stores
+                    if isinstance(node.ctx, ast.Store)
+                    else self.attr_loads
+                )
+                bucket[key].append(((cls, node.attr), acc()))
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            if key is not None and node.id in self.funcs[key].globals:
+                self.attr_stores[key].append((("", node.id), acc()))
+        elif isinstance(node, ast.Call):
+            d = dotted_name(node.func)
+            self.calls[key].append((d, acc()))
+            callee = self._resolve(node.func, key)
+            if callee is not None:
+                self.edges[key].add(callee)
+            if d == "signal.signal" and len(node.args) == 2:
+                h = self._resolve(node.args[1], key)
+                if h is not None:
+                    self.signal_handlers.append((h, node))
+            if _leaf(d) == "join" and isinstance(node.func, ast.Attribute):
+                recv = self._canon(node.func.value, key)
+                if recv is not None:
+                    self.joins.add(recv)
+
+    # -- spawns -----------------------------------------------------------
+
+    def _collect_spawns(self):
+        for key in [None, *self.funcs]:
+            for d, acc in self.calls[key]:
+                if d not in _THREAD_CTORS:
+                    continue
+                call = acc.node
+                sp = _Spawn(call, key)
+                for kw in call.keywords:
+                    if kw.arg == "target":
+                        sp.target_key = self._resolve(kw.value, key)
+                        sp.target_leaf = _leaf(dotted_name(kw.value))
+                    elif kw.arg == "daemon":
+                        sp.daemon = _const_bool(kw.value)
+                    elif kw.arg == "name":
+                        if isinstance(kw.value, ast.Constant):
+                            sp.name = str(kw.value.value)
+                p = self._parents.get(call)
+                if isinstance(p, ast.Assign):
+                    sp.binding = "bound"
+                    for tgt in p.targets:
+                        cid = self._canon(tgt, key)
+                        if cid is not None:
+                            sp.handles.append(cid)
+                elif isinstance(p, ast.Attribute) and p.attr == "start":
+                    sp.binding = "dropped-start"
+                elif isinstance(p, ast.Expr):
+                    sp.binding = "dropped"
+                self.spawns.append(sp)
+        for node in ast.walk(self.facts.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.targets[0], ast.Attribute)
+                and node.targets[0].attr == "daemon"
+                and _const_bool(node.value) is True
+            ):
+                cid = self._canon(
+                    node.targets[0].value, self._enclosing_scope(node)
+                )
+                if cid is not None:
+                    self.daemon_sets.add(cid)
+
+    def spawn_is_daemon(self, sp: _Spawn) -> bool:
+        if sp.daemon is not None:
+            return sp.daemon
+        return any(h in self.daemon_sets for h in sp.handles)
+
+    def _resolve_leafcall(self, d: str, scope: str | None) -> str | None:
+        """Re-resolve a *recorded* dotted call string to a function key, so
+        _daemon_file_ops can ask "who calls `_write`, and are all of those
+        call sites inside a try/except OSError?"."""
+        if "." not in d:
+            k = scope
+            while k is not None:
+                f = self.funcs[k]
+                if d in f.children:
+                    return f.children[d]
+                k = f.parent
+            return self.top.get(d)
+        if d.startswith("self.") and "." not in d[5:] and scope:
+            cls = self.funcs[scope].cls
+            if cls and f"{cls}.{d[5:]}" in self.funcs:
+                return f"{cls}.{d[5:]}"
+        return None
+
+
+class ProjectConcurrency:
+    """The JX015-JX019 checks over the configured thread-module set."""
+
+    def __init__(self, root: Path, config: LintConfig):
+        self.root = Path(root)
+        self.config = config
+        self.modules: dict[str, ModuleFacts] = {}
+        self.threads: dict[str, _ModuleThreads] = {}
+        for rel in config.thread_modules:
+            p = self.root / rel
+            if not p.exists():
+                continue
+            try:
+                facts = ModuleFacts(rel, p.read_text())
+            except SyntaxError:
+                continue
+            self.modules[rel] = facts
+            self.threads[rel] = _ModuleThreads(facts, config)
+
+    # -- JX015 ------------------------------------------------------------
+
+    def check_shared_state(self) -> Iterator[Finding]:
+        for rel in sorted(self.threads):
+            mt = self.threads[rel]
+            if not mt.thread_reach:
+                continue
+            thread_writes: dict[tuple, list[_Access]] = {}
+            other_access: dict[tuple, list[_Access]] = {}
+            for key in mt.funcs:
+                for attr, acc in mt.attr_stores[key]:
+                    if key in mt.thread_reach:
+                        thread_writes.setdefault(attr, []).append(acc)
+                    if key in mt.other_reach:
+                        other_access.setdefault(attr, []).append(acc)
+                if key in mt.other_reach:
+                    for attr, acc in mt.attr_loads[key]:
+                        other_access.setdefault(attr, []).append(acc)
+            for attr, acc in [*mt.attr_stores[None], *mt.attr_loads[None]]:
+                other_access.setdefault(attr, []).append(acc)
+            for attr in sorted(thread_writes):
+                if attr[1] in self.config.lock_attr_names:
+                    continue  # the lock object itself is the synchronizer
+                hit = next(
+                    (
+                        (w, o)
+                        for w in thread_writes[attr]
+                        for o in other_access.get(attr, [])
+                        if not (w.held & o.held)
+                    ),
+                    None,
+                )
+                if hit is None:
+                    continue
+                w, o = hit
+                where = (
+                    "the same line runs in both thread and caller context"
+                    if o.node is w.node
+                    else f"also accessed at line {o.node.lineno}"
+                )
+                name = f"{attr[0]}.{attr[1]}" if attr[0] else attr[1]
+                yield self.modules[rel].finding(
+                    "JX015", w.node,
+                    f"`{name}` is written from a spawned thread and {where} "
+                    f"with no common lock held — unsynchronized shared "
+                    f"state (guard both sites with one lock, or make the "
+                    f"write single-context)",
+                )
+
+    # -- JX016 ------------------------------------------------------------
+
+    def check_lifecycle(self) -> Iterator[Finding]:
+        for rel in sorted(self.threads):
+            mt = self.threads[rel]
+            for sp in mt.spawns:
+                what = sp.name or sp.target_leaf or "thread"
+                if sp.binding == "dropped-start":
+                    yield self.modules[rel].finding(
+                        "JX016", sp.node,
+                        f"`{what}` thread handle dropped at start() — "
+                        f"unjoinable and unreapable; bind the Thread object "
+                        f"so callers can join or inspect it",
+                    )
+                    continue
+                if sp.binding == "dropped":
+                    yield self.modules[rel].finding(
+                        "JX016", sp.node,
+                        f"`{what}` Thread constructed and discarded — "
+                        f"never started, never joinable",
+                    )
+                    continue
+                daemon = mt.spawn_is_daemon(sp)
+                if not daemon and sp.binding == "bound" and not any(
+                    h in mt.joins or _leaf(h) in {_leaf(j) for j in mt.joins}
+                    for h in sp.handles
+                ):
+                    yield self.modules[rel].finding(
+                        "JX016", sp.node,
+                        f"non-daemon thread `{what}` is never join()ed on "
+                        f"any path — it will outlive shutdown and block "
+                        f"interpreter exit",
+                    )
+                if daemon and sp.target_key is not None:
+                    yield from self._daemon_file_ops(rel, mt, sp)
+
+    def _daemon_file_ops(self, rel, mt, sp):
+        body = mt._closure({sp.target_key})
+        for key in sorted(body):
+            for d, acc in mt.calls[key]:
+                leaf = _leaf(d)
+                if not (leaf in _FILE_OP_LEAVES or d in _FILE_OP_DOTTED):
+                    continue
+                if acc.protected:
+                    continue
+                # One level up: protected if every thread-context call site
+                # of this function sits in a try/except-OSError (the fleet
+                # `_loop` -> `_write` shape).
+                callers = [
+                    c
+                    for ck in body
+                    for c in mt.calls[ck]
+                    if c[0] is not None
+                    and mt._resolve_leafcall(c[0], ck) == key
+                ]
+                if callers and all(c[1].protected for c in callers):
+                    continue
+                what = sp.name or sp.target_leaf or "daemon thread"
+                yield self.modules[rel].finding(
+                    "JX016", acc.node,
+                    f"daemon thread `{what}` touches a file via "
+                    f"`{leaf}` with no try/except OSError on the write "
+                    f"path — a late I/O error kills the daemon silently "
+                    f"(use the heartbeat beat-retry pattern)",
+                )
+
+    # -- JX017 ------------------------------------------------------------
+
+    def check_lock_order(self) -> Iterator[Finding]:
+        first: dict[tuple[str, str], tuple[str, ast.AST]] = {}
+        for rel in sorted(self.threads):
+            for a, b, node in self.threads[rel].order_edges:
+                first.setdefault((a, b), (rel, node))
+        done: set[frozenset] = set()
+        for (a, b), (rel, node) in sorted(
+            first.items(), key=lambda kv: (kv[1][0], kv[1][1].lineno)
+        ):
+            if (b, a) not in first or frozenset((a, b)) in done:
+                continue
+            done.add(frozenset((a, b)))
+            orel, onode = first[(b, a)]
+            yield self.modules[rel].finding(
+                "JX017", node,
+                f"locks `{a}` and `{b}` are acquired nested in both orders "
+                f"(reverse order at {orel}:{onode.lineno}) — inconsistent "
+                f"lock ordering deadlocks under contention; pick one "
+                f"global order",
+            )
+
+    # -- JX018 ------------------------------------------------------------
+
+    def check_blocking_under_lock(self) -> Iterator[Finding]:
+        pats = self.config.blocking_call_patterns
+        dotted_pats = frozenset(p for p in pats if "." in p)
+        leaf_pats = frozenset(p for p in pats if "." not in p)
+        dev_pats = self.config.device_call_patterns
+        for rel in sorted(self.threads):
+            mt = self.threads[rel]
+            for key in [None, *mt.funcs]:
+                for d, acc in mt.calls[key]:
+                    if not acc.held:
+                        continue
+                    leaf = _leaf(d)
+                    if leaf is None:
+                        continue
+                    lock = sorted(acc.held)[0]
+                    call = acc.node
+                    if d in dotted_pats or (
+                        leaf in leaf_pats
+                        and not (
+                            leaf in ("wait", "get") and _has_timeout(call)
+                        )
+                    ):
+                        yield self.modules[rel].finding(
+                            "JX018", call,
+                            f"blocking call `{d}` while holding `{lock}` — "
+                            f"every other acquirer stalls behind this I/O; "
+                            f"move the call outside the critical section",
+                        )
+                    elif (
+                        leaf == "get"
+                        and isinstance(call.func, ast.Attribute)
+                        and not _has_timeout(call)
+                    ):
+                        recv = mt._canon(call.func.value, key)
+                        if recv in mt.queues:
+                            yield self.modules[rel].finding(
+                                "JX018", call,
+                                f"untimed `{recv}.get()` while holding "
+                                f"`{lock}` — an empty queue parks this "
+                                f"thread forever with the lock held",
+                            )
+                    elif any(p in leaf for p in dev_pats):
+                        yield self.modules[rel].finding(
+                            "JX018", call,
+                            f"device dispatch `{d}` while holding `{lock}` "
+                            f"— a compile or transfer stall serializes "
+                            f"every thread behind the lock",
+                        )
+
+    # -- JX019 ------------------------------------------------------------
+
+    def check_fork_and_signals(self) -> Iterator[Finding]:
+        for rel in sorted(self.threads):
+            mt = self.threads[rel]
+            flagged: set[ast.AST] = set()
+            for key in sorted(mt.thread_reach):
+                if key not in mt.calls:
+                    continue
+                for d, acc in mt.calls[key]:
+                    if d in _SPAWN_CALLS:
+                        flagged.add(acc.node)
+                        yield self.modules[rel].finding(
+                            "JX019", acc.node,
+                            f"process spawn `{d}` from thread context — "
+                            f"the child inherits locks other threads hold "
+                            f"at fork time; spawn from the main thread "
+                            f"(spawn-before-threads ordering)",
+                        )
+            if mt.spawns:
+                for key in [None, *mt.funcs]:
+                    for d, acc in mt.calls[key]:
+                        if d in _FORK_CALLS and acc.node not in flagged:
+                            yield self.modules[rel].finding(
+                                "JX019", acc.node,
+                                f"`{d}` in a module that starts threads — "
+                                f"fork without exec after threads exist is "
+                                f"undefined behavior; use subprocess or "
+                                f"fork before any Thread.start()",
+                            )
+            for handler, _reg in mt.signal_handlers:
+                for key in sorted(mt._closure({handler})):
+                    for lid, node in mt.lock_enters.get(key, ()):
+                        yield self.modules[rel].finding(
+                            "JX019", node,
+                            f"signal handler `{handler}` acquires lock "
+                            f"`{lid}` — handlers interrupt arbitrary "
+                            f"bytecode, including the holder of that lock "
+                            f"(self-deadlock); set an Event or flag "
+                            f"instead",
+                        )
+                    for d, acc in mt.calls.get(key, ()):
+                        leaf = _leaf(d)
+                        recv = (
+                            mt._canon(acc.node.func.value, key)
+                            if isinstance(acc.node.func, ast.Attribute)
+                            else None
+                        )
+                        if (
+                            leaf in ("acquire", "join")
+                            or (leaf in ("get", "put") and recv in mt.queues)
+                        ):
+                            yield self.modules[rel].finding(
+                                "JX019", acc.node,
+                                f"non-async-signal-safe call `{d}` "
+                                f"reachable from signal handler "
+                                f"`{handler}` — handlers may run with "
+                                f"that object's internal lock held",
+                            )
+
+
+# ---------------------------------------------------------------------------
+# Registry + entry point (mirrors contracts.CONTRACT_RULES).
+
+ConcurrencyFn = Callable[[ProjectConcurrency], Iterator[Finding]]
+
+CONCURRENCY_RULES: dict[str, tuple[ConcurrencyFn, str]] = {
+    "JX015": (
+        ProjectConcurrency.check_shared_state,
+        "attribute/global written in a thread body and touched from "
+        "another context with no common lock",
+    ),
+    "JX016": (
+        ProjectConcurrency.check_lifecycle,
+        "non-daemon thread never joined; dropped thread handle; daemon "
+        "file I/O without the beat-retry OSError guard",
+    ),
+    "JX017": (
+        ProjectConcurrency.check_lock_order,
+        "nested lock acquisitions in inconsistent order (deadlock)",
+    ),
+    "JX018": (
+        ProjectConcurrency.check_blocking_under_lock,
+        "device dispatch / subprocess wait / untimed queue.get inside a "
+        "held-lock region",
+    ),
+    "JX019": (
+        ProjectConcurrency.check_fork_and_signals,
+        "fork/subprocess from thread context; non-async-signal-safe work "
+        "in signal handlers",
+    ),
+}
+
+
+def lint_concurrency(
+    root: Path,
+    config: LintConfig | None = None,
+    rules=None,
+) -> list[Finding]:
+    """Run the thread-safety rules over the project at ``root``.
+    ``rules`` filters to a subset of CONCURRENCY_RULES ids; findings honor
+    in-file suppression comments and the shared baseline fingerprints."""
+    config = config or LintConfig()
+    enabled = [
+        r.upper() for r in (rules if rules is not None else config.enabled_rules)
+    ]
+    wanted = [r for r in enabled if r in CONCURRENCY_RULES]
+    if not wanted:
+        return []
+    ctx = ProjectConcurrency(Path(root), config)
+    findings: list[Finding] = []
+    seen: set[tuple[str, str, int, int, str]] = set()
+    for rule_id in wanted:
+        fn, _ = CONCURRENCY_RULES[rule_id]
+        for f in fn(ctx):
+            key = (f.rule, f.path, f.line, f.col, f.message)
+            if key in seen:
+                continue
+            m = ctx.modules.get(f.path)
+            if m is not None and m.suppressions.is_suppressed(f.rule, f.line):
+                continue
+            seen.add(key)
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

@@ -156,7 +156,36 @@ _DEFAULT_METRICS_MODULE = "tpusim/metrics.py"
 #: Configs whose SLO objectives (``[tool.tpusim-slo]`` / JSON "objectives")
 #: may only reference registered metric families (JX014).
 _DEFAULT_SLO_CONFIG_FILES = ("pyproject.toml",)
-_ALL_RULE_IDS = tuple(f"JX{n:03d}" for n in range(1, 15))
+# -- Concurrency-pass knowledge (tpusim.lint.concurrency, JX015-JX019). -----
+#: Modules that create threads, hold locks, or run in thread context today
+#: (fleet heartbeat, chaos watchdog, metrics HTTP server, bench hard
+#: watchdog) plus engine.py so the pipelined done-flag path is covered —
+#: the future `tpusim serve` modules join this list the day they appear.
+_DEFAULT_THREAD_MODULES = (
+    "tpusim/chaos.py",
+    "tpusim/engine.py",
+    "tpusim/fleet.py",
+    "tpusim/metrics.py",
+    "bench.py",
+)
+#: Attribute/variable leaf names that ARE locks for the with-lock dataflow
+#: (names assigned from ``threading.Lock()`` are recognized regardless).
+_DEFAULT_LOCK_ATTRS = ("_lock", "lock", "_mutex")
+#: Call patterns that block (JX018) when made inside a held-lock region;
+#: dotted entries match the full dotted call, bare entries match the leaf
+#: (timed ``.wait(t)``/``.get(timeout=)`` variants are exempt).
+_DEFAULT_BLOCKING_CALLS = (
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "wait",
+    "communicate",
+    "accept",
+    "serve_forever",
+    "sleep",
+)
+_ALL_RULE_IDS = tuple(f"JX{n:03d}" for n in range(1, 20))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -191,6 +220,10 @@ class LintConfig:
     flag_ignore: tuple[str, ...] = _DEFAULT_FLAG_IGNORE
     metrics_module: str = _DEFAULT_METRICS_MODULE
     slo_config_files: tuple[str, ...] = _DEFAULT_SLO_CONFIG_FILES
+    # Concurrency-pass knowledge (JX015-JX019; tpusim.lint.concurrency).
+    thread_modules: tuple[str, ...] = _DEFAULT_THREAD_MODULES
+    lock_attr_names: tuple[str, ...] = _DEFAULT_LOCK_ATTRS
+    blocking_call_patterns: tuple[str, ...] = _DEFAULT_BLOCKING_CALLS
 
     def matches(self, rel_path: str, globs: tuple[str, ...]) -> bool:
         rel = rel_path.replace("\\", "/")
@@ -242,6 +275,9 @@ def load_config(pyproject: Path | None = None) -> LintConfig:
         ("cli_modules", "cli-modules"),
         ("flag_ignore", "flag-ignore"),
         ("slo_config_files", "slo-config-files"),
+        ("thread_modules", "thread-modules"),
+        ("lock_attr_names", "lock-attr-names"),
+        ("blocking_call_patterns", "blocking-call-patterns"),
     ):
         if key in block:
             kwargs[field] = tuple(str(v) for v in block[key])

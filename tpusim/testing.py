@@ -15,11 +15,18 @@ exact-state test of the vectorized kernel.
 (tpusim.lint): the linter can only flag recompilation *risk* statically; the
 guard pins the actual XLA compile count of a block, so tier-1 tests enforce
 that the headline batch loop compiles exactly once per program shape.
+
+``thread_leak_guard`` is the same pattern applied to the JX015-JX019
+thread-safety pass: the linter pins lifecycle discipline statically; the
+guard pins the live thread population of a block, so the fleet/chaos/metrics
+suites enforce "no new non-daemon threads, bounded daemon delta" at runtime.
 """
 
 from __future__ import annotations
 
 import contextlib
+import threading
+import time
 from typing import Sequence
 
 import numpy as np
@@ -282,6 +289,64 @@ def compile_count_guard(*, exact: int | None = None, max_compiles: int | None = 
             f"expected <= {max_compiles} XLA compilation(s) in block, observed "
             f"{counter.count}: {counter.events}"
         )
+
+
+class ThreadCensus:
+    """Live census handed out by :func:`thread_leak_guard`."""
+
+    def __init__(self) -> None:
+        self.before: set[int] = {
+            t.ident for t in threading.enumerate() if t.ident is not None
+        }
+
+    def new_threads(self) -> list[threading.Thread]:
+        """Threads alive now that were not alive when the guard entered."""
+        return [
+            t for t in threading.enumerate()
+            if t.is_alive() and t.ident not in self.before
+        ]
+
+
+@contextlib.contextmanager
+def thread_leak_guard(*, max_daemon_delta: int = 0, settle_s: float = 5.0):
+    """Assert the ``with`` block leaks no threads: zero new *non-daemon*
+    threads and at most ``max_daemon_delta`` new daemon threads at exit.
+
+    This is the enforcement half of the JX015-JX019 lint pass: the linter
+    flags lifecycle *discipline* statically (unjoined non-daemon threads,
+    dropped handles); this guard pins the measured thread population, so a
+    test can state "this drill leaves the process thread-clean" as an
+    invariant instead of a hope. Usage::
+
+        with thread_leak_guard(max_daemon_delta=1):
+            run_fleet_drill()   # may keep ONE reusable daemon (watchdog)
+
+    Exit polls briefly (``settle_s``, 20 ms steps) before failing, so
+    threads mid-teardown — a joined worker whose OS thread has not yet
+    vanished from ``threading.enumerate()`` — do not flake the guard.
+    Identity is by thread ident, so a thread that exits and is replaced by
+    an equivalent one still counts as a delta (by design: churn is a leak
+    with extra steps).
+    """
+    census = ThreadCensus()
+    yield census
+    deadline = time.monotonic() + settle_s
+    while True:
+        new = census.new_threads()
+        non_daemon = [t for t in new if not t.daemon]
+        daemons = [t for t in new if t.daemon]
+        if not non_daemon and len(daemons) <= max_daemon_delta:
+            return
+        if time.monotonic() >= deadline:
+            break
+        time.sleep(0.02)
+    names = [f"{t.name}{'' if t.daemon else ' (non-daemon)'}" for t in new]
+    raise AssertionError(
+        f"thread leak: {len(non_daemon)} new non-daemon thread(s) and "
+        f"{len(daemons)} new daemon thread(s) (allowed: 0 non-daemon, "
+        f"{max_daemon_delta} daemon) still alive {settle_s:.0f}s after "
+        f"block exit: {names}"
+    )
 
 
 _active_counters: list[CompileCount] = []
