@@ -24,11 +24,11 @@ python -m tpusim.cli lint --baseline .tpusim-lint-baseline.json
 # that greens while checking nothing. --list-rules annotates disabled rules,
 # so the floor counts rules that will actually RUN in the gate above.
 rule_count=$(python -m tpusim.cli lint --list-rules | grep -cv "(disabled)")
-if [ "$rule_count" -lt 19 ]; then
-  echo "lint gate degraded: only $rule_count rules enabled (need >= 19)" >&2
+if [ "$rule_count" -lt 20 ]; then
+  echo "lint gate degraded: only $rule_count rules enabled (need >= 20)" >&2
   exit 1
 fi
-for contract_rule in JX013 JX014 JX015 JX016 JX017 JX018 JX019; do
+for contract_rule in JX013 JX014 JX015 JX016 JX017 JX018 JX019 JX020; do
   python -m tpusim.cli lint --list-rules | grep "^$contract_rule" | grep -qv "(disabled)" \
     || { echo "contract rule $contract_rule missing/disabled in --list-rules" >&2; exit 1; }
 done
@@ -156,6 +156,11 @@ echo "== telemetry smoke =="
 # against a span-schema or dashboard regression landing silently.
 tele_dir=$(mktemp -d)
 trap 'rm -rf "$tele_dir"' EXIT
+# Arm the provenance plane for every artifact-producing leg from here on
+# (the env var is inherited by sweep/fleet/perf subprocesses AND their
+# workers): rows, perf rows, checkpoints and flight exports all append
+# content-addressed lineage records the audit leg below joins and gates.
+export TPUSIM_PROVENANCE="$tele_dir/provenance/lineage.jsonl"
 env JAX_PLATFORMS=cpu python -m tpusim --runs 4 --batch-size 4 \
   --duration-ms 86400000 --single-device --quiet \
   --telemetry "$tele_dir/smoke.jsonl"
@@ -475,5 +480,43 @@ else
   echo "SKIP: sanitizer harness leg NOT run (compiler lacks libasan/libubsan" \
        "runtimes or the sanitize build failed)" >&2
 fi
+
+echo "== provenance audit (cross-plane consistency gate) =="
+# Every artifact-producing leg above ran ARMED (TPUSIM_PROVENANCE exported
+# with the telemetry-smoke leg), so one lineage ledger now spans the smoke
+# run, both sweeps (sequential + packed + resumed), the fleet drill's
+# workers, the perf/loadgen rows, the piece checkpoints and the flight
+# exports. `tpusim audit` joins all of it — lineage + spans + fleet ledger
+# + perf ledger + checkpoint npz fingerprints — and verifies the audit
+# invariants. Deliberately NO JAX_PLATFORMS: the audit plane is jax-free by
+# design and must stay that way (the `tpusim watch` rule).
+python -m tpusim audit "$tele_dir"
+# The gate must be able to turn RED: mutate one value in one on-disk sweep
+# row (its content hash then resolves to no lineage record), require exit 1,
+# restore, require exit 0 again. A gate that cannot fail is a dead gate.
+cp "$packed_dir/seq.jsonl" "$packed_dir/seq.jsonl.orig"
+sed -i '1s/"runs": 8/"runs": 9/' "$packed_dir/seq.jsonl"
+audit_rc=0; python -m tpusim audit "$tele_dir" --quiet >/dev/null 2>&1 || audit_rc=$?
+[ "$audit_rc" -eq 1 ] \
+  || { echo "audit mutation drill: mutated row exited $audit_rc, want 1" >&2; exit 1; }
+mv "$packed_dir/seq.jsonl.orig" "$packed_dir/seq.jsonl"
+python -m tpusim audit "$tele_dir" --quiet
+# Dead-gate drill: with the env ledger masked, an artifact root holding ZERO
+# lineage records must exit 2 — an empty ledger can never pass green.
+audit_empty=$(mktemp -d)
+audit_rc=0; env -u TPUSIM_PROVENANCE python -m tpusim audit "$audit_empty" \
+  >/dev/null 2>&1 || audit_rc=$?
+[ "$audit_rc" -eq 2 ] \
+  || { echo "audit dead-gate drill: empty root exited $audit_rc, want 2" >&2; exit 1; }
+rm -rf "$audit_empty"
+# The lineage tree walks from a real on-disk row back through the run that
+# produced it, and the sealed evidence bundle round-trips offline.
+python -m tpusim lineage show "$packed_dir/seq.jsonl" | grep -q "sweep_row"
+env JAX_PLATFORMS=cpu python -m tpusim report "$tele_dir/smoke.jsonl" \
+  --lineage "$TPUSIM_PROVENANCE" | grep -q "Provenance (lineage ledger)"
+python -m tpusim bundle create "$tele_dir/evidence.tar.gz" \
+  "$tele_dir/provenance" "$tele_dir/smoke.jsonl" "$tele_dir/perf_quick.jsonl"
+python -m tpusim bundle verify "$tele_dir/evidence.tar.gz"
+echo "provenance audit: gate green, mutation drill red/green, bundle sealed"
 
 echo "== CI green =="

@@ -102,4 +102,22 @@ for path in sys.argv[1:]:
     print(f"[harvest] {path}: {validate_openmetrics(open(path).read())} samples OK")
 EOF
 fi
+# Lineage ledgers (armed runs — TPUSIM_PROVENANCE — append content-addressed
+# records here; TPU windows rsync their provenance/ dirs back next to the
+# telemetry they attest): strictly re-verify every record hash so a mutated
+# or torn ledger fails the harvest, exactly like a corrupt trace or perf row.
+# Strict load refuses tampered records outright — the audit CLI (`tpusim
+# audit artifacts/`) is the richer cross-plane gate; this is the cheap
+# integrity floor every harvest pays. jax-free (tpusim.provenance imports no
+# backend).
+lineage_ledgers=$(find artifacts -name "lineage.jsonl" 2>/dev/null || true)
+if [ -n "$lineage_ledgers" ]; then
+  python - $lineage_ledgers <<'EOF'
+import sys
+from tpusim.provenance import load_lineage
+for path in sys.argv[1:]:
+    print(f"[harvest] {path}: {len(load_lineage(path, strict=True))} "
+          "lineage records OK")
+EOF
+fi
 git status --short BASELINE.json REFSCALE.md artifacts/

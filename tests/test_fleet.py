@@ -16,6 +16,7 @@ Two tiers, mirroring the module's design:
 from __future__ import annotations
 
 import json
+import os
 import signal
 import subprocess
 import sys
@@ -26,9 +27,11 @@ from types import SimpleNamespace
 
 import pytest
 
+import tpusim.provenance as provenance
 from tpusim.chaos import ChaosInjector, ChaosPlan, FaultSpec, load_plan
 from tpusim.config import SimConfig, default_network
 from tpusim.fleet import WORKER_CHAOS_ENV, FleetSupervisor
+from tpusim.provenance import PROVENANCE_ENV, content_address, load_lineage
 from tpusim.report import render_report
 from tpusim.runner import run_simulation_config
 from tpusim.telemetry import load_spans
@@ -413,25 +416,38 @@ DRILL_PLANS = {
 def drill(tmp_path_factory):
     tmp = tmp_path_factory.mktemp("fleet_drill")
     points = [(name, DRILL_CONFIG) for name in DRILL_PLANS]
-    sup = FleetSupervisor(
-        points,
-        workers=2,
-        state_dir=tmp / "fleet",
-        telemetry_path=tmp / "fleet" / "tele.jsonl",
-        worker_chaos=DRILL_PLANS,
-        single_device=True,
-        lease_s=10.0,
-        heartbeat_s=0.25,
-        backoff_s=0.05,
-        poll_s=0.1,
-        quiet=True,
-    )
-    summary = sup.run()
+    # Arm the provenance plane for the whole fleet (workers inherit the
+    # env var), so the drill doubles as the lineage kill drill: SIGKILLed
+    # writers must leave every fsync'd ledger record whole-or-absent, and
+    # each healed row must chain back to the checkpoint it resumed from.
+    lineage_path = tmp / "fleet" / "provenance" / "lineage.jsonl"
+    os.environ[PROVENANCE_ENV] = str(lineage_path)
+    provenance._WRITERS.clear()
+    try:
+        sup = FleetSupervisor(
+            points,
+            workers=2,
+            state_dir=tmp / "fleet",
+            telemetry_path=tmp / "fleet" / "tele.jsonl",
+            worker_chaos=DRILL_PLANS,
+            single_device=True,
+            lease_s=10.0,
+            heartbeat_s=0.25,
+            backoff_s=0.05,
+            poll_s=0.1,
+            quiet=True,
+        )
+        summary = sup.run()
+    finally:
+        # Disarm BEFORE the reference run: the ref row is never written to
+        # disk, so recording it would only pad the ledger.
+        os.environ.pop(PROVENANCE_ENV, None)
+        provenance._WRITERS.clear()
     ref = run_simulation_config(
         DRILL_CONFIG, use_all_devices=False, engine_cache=ENGINE_CACHE
     )
     return SimpleNamespace(
-        sup=sup, summary=summary,
+        sup=sup, summary=summary, lineage_path=lineage_path,
         ref_row={**ref.to_dict(), "backend": "tpu"},
     )
 
@@ -489,6 +505,59 @@ def test_drill_healing_workers_resume_from_durable_checkpoints(drill):
     # ...and pre_replace's stale tmp file was swept with the warning.
     pre_log = (workers_dir / f"{healer['pt-kill-pre']}.log").read_text()
     assert "removing stale checkpoint temp file" in pre_log
+
+
+def test_drill_lineage_ledger_survives_the_kills_whole(drill):
+    # The lineage kill drill: five worker processes (two at a time) appended
+    # to ONE fsync'd ledger while being SIGKILLed, wedged and ENOSPC'd —
+    # every surviving record must be whole (strict load re-hashes each
+    # record; a torn or interleaved line raises), and the file must end on
+    # a newline: whole-or-absent, never torn.
+    raw = drill.lineage_path.read_bytes()
+    assert raw.endswith(b"\n")
+    records = load_lineage(drill.lineage_path, strict=True)
+    kinds = {r["kind"] for r in records}
+    assert {"run", "fleet_row", "checkpoint", "checkpoint_load"} <= kinds
+    # Every point published a row through the fleet_row seam.
+    assert {r.get("point") for r in records if r["kind"] == "fleet_row"} == set(
+        DRILL_PLANS
+    )
+
+
+def test_drill_healed_rows_chain_to_their_checkpoints(drill):
+    # The heal lineage, walked by hand: a published row's content address
+    # resolves to its fleet_row record, whose parent chain reaches the
+    # checkpoint the replacement worker resumed from — while a point killed
+    # BEFORE its first durable save restarts from zero, parentless.
+    records = load_lineage(drill.lineage_path)
+    by_addr: dict[str, dict] = {}
+    for rec in records:
+        for a in (rec.get("content_sha256"), rec.get("artifact_id")):
+            if isinstance(a, str):
+                by_addr.setdefault(a, rec)
+    chains = {}
+    for row in rows_of(drill.sup):
+        addr = content_address(row)
+        assert addr in by_addr, row["point"]  # row-lineage, by hand
+        chains[row["point"]] = provenance._ancestor_kinds(addr, by_addr)
+    for point in ("pt-kill-post", "pt-hang"):  # died AFTER a durable save
+        assert {"checkpoint_load", "checkpoint"} <= chains[point], point
+    for point in ("pt-kill-begin", "pt-kill-pre", "pt-enospc"):
+        assert "checkpoint_load" not in chains[point], point
+
+
+def test_drill_audit_gate_green_with_heal_facts_checked(drill):
+    # `tpusim audit` over the drilled state dir: all invariants green, and
+    # the fleet-specific ones actually CHECKED facts (a zero-checked
+    # invariant would make this a dead gate for the fleet plane).
+    scan = provenance.scan_artifacts([drill.sup.state_dir])
+    violations, checked = provenance.run_audit(scan)
+    assert violations == []
+    assert checked["heal-parented"] >= 1
+    assert checked["runs-consistent"] >= 1
+    assert checked["checkpoint-fingerprint"] >= 1
+    assert checked["row-lineage"] >= len(DRILL_PLANS)
+    assert provenance.audit_main([str(drill.sup.state_dir), "--quiet"]) == 0
 
 
 def test_drill_dashboards_render_fleet_panels(drill):

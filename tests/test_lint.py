@@ -1421,7 +1421,9 @@ def test_contract_rules_listed_and_registered(capsys):
     repo's config (the floor greps out "(disabled)" annotations, so a
     pyproject enabled-rules regression shows up here, not just a registry
     slip)."""
-    assert set(CONTRACT_RULES) == {"JX010", "JX011", "JX012", "JX013", "JX014"}
+    assert set(CONTRACT_RULES) == {
+        "JX010", "JX011", "JX012", "JX013", "JX014", "JX020",
+    }
     assert set(CONCURRENCY_RULES) == {
         "JX015", "JX016", "JX017", "JX018", "JX019",
     }
@@ -1430,7 +1432,7 @@ def test_contract_rules_listed_and_registered(capsys):
     enabled_lines = [
         ln for ln in out.splitlines() if ln.strip() and "(disabled)" not in ln
     ]
-    assert len(enabled_lines) >= 19
+    assert len(enabled_lines) >= 20
     for rid in (*CONTRACT_RULES, *CONCURRENCY_RULES):
         assert any(ln.startswith(rid) for ln in enabled_lines)
 

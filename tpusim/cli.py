@@ -22,6 +22,10 @@ Examples:
     python -m tpusim metrics export fleet/ --out artifacts/metrics/fleet.prom
     python -m tpusim metrics serve --state-dir fleet/ --port 9109
     python -m tpusim slo check fleet/
+    python -m tpusim audit fleet/ --lineage artifacts/provenance/lineage.jsonl
+    python -m tpusim lineage show rows.jsonl --lineage artifacts/provenance/lineage.jsonl
+    python -m tpusim bundle create evidence.tar rows.jsonl artifacts/provenance/
+    python -m tpusim bundle verify evidence.tar
 
 The ``report`` subcommand (tpusim.report) renders a ``--telemetry`` JSONL
 ledger — or a ``--trace-dir`` XLA trace directory — into a dashboard; the
@@ -260,6 +264,26 @@ def main(argv: list[str] | None = None) -> int:
         from .fleet import main as fleet_main
 
         return fleet_main(argv[1:])
+    if argv and argv[0] == "audit":
+        # Same dispatch rule. The cross-plane consistency gate joins ledgers
+        # already on disk — jax-free, perf-compare exit discipline (0 pass /
+        # 1 violation / 2 structural-or-dead-gate), runs on any host
+        # (tpusim.provenance).
+        from .provenance import audit_main
+
+        return audit_main(argv[1:])
+    if argv and argv[0] == "lineage":
+        # Same dispatch rule. Walks an artifact's recorded parent chain —
+        # pure ledger reads, no backend import ever (tpusim.provenance).
+        from .provenance import lineage_main
+
+        return lineage_main(argv[1:])
+    if argv and argv[0] == "bundle":
+        # Same dispatch rule. Seals/verifies evidence tarballs offline —
+        # stdlib tarfile + sha256 only (tpusim.provenance).
+        from .provenance import bundle_main
+
+        return bundle_main(argv[1:])
     args = build_parser().parse_args(argv)
     try:
         config = config_from_args(args)

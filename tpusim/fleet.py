@@ -81,6 +81,7 @@ from typing import Any, Callable, Iterable
 
 from .chaos import ChaosError, ChaosInjector, ChaosPlan, InjectedHang, as_injector
 from .config import SimConfig
+from .provenance import emit_lineage, lineage_armed, lineage_last
 from .telemetry import TelemetryRecorder, append_jsonl_line
 from .tracing import TRACE_ENV, TraceContext
 
@@ -319,6 +320,19 @@ def worker_main(argv: list[str] | None = None) -> int:
             "backend": "tpu",
             "elapsed_s": round(time.monotonic() - t0, 3),
         }
+        if lineage_armed():
+            # The published row's lineage record, citing the run record the
+            # runner just emitted in this process — which itself cites the
+            # checkpoint_load when this worker healed a dead one's lease.
+            # (Grid workers need no equivalent: their rows flow through
+            # sweep.emit_row, which records them.) The supervisor writes
+            # this payload verbatim, so the on-disk row re-hashes to the
+            # same content address.
+            emit_lineage(
+                "fleet_row", content=payload,
+                parents=(lineage_last("run"),),
+                point=args.point, runs=payload.get("runs"), backend="tpu",
+            )
     tmp = args.result.with_name(args.result.name + ".tmp")
     tmp.write_text(json.dumps(payload))
     os.replace(tmp, args.result)  # atomic publish: the supervisor never
@@ -503,7 +517,10 @@ class FleetSupervisor:
 
     def _log_event(self, event: str, **fields: Any) -> None:
         row = {"event": event, "t": round(time.time(), 3), **fields}
-        append_jsonl_line(self.ledger_path, json.dumps(row))
+        # fsync'd: the work ledger is evidence (leases, requeues, quarantine
+        # verdicts) the audit gate joins against — a SIGKILL'd supervisor
+        # must not leave its last decision unrecorded or torn.
+        append_jsonl_line(self.ledger_path, json.dumps(row), fsync=True)
 
     def _say(self, msg: str) -> None:
         if not self.quiet:

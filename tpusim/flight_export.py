@@ -419,6 +419,27 @@ def main(argv: list[str] | None = None) -> int:
     _write_artifact(args.trace_out, json.dumps(trace))
     if args.events_out is not None:
         _write_artifact(args.events_out, events_jsonl(log.events))
+    from .provenance import emit_lineage, lineage_armed, sha256_file
+
+    if lineage_armed():
+        # Exported files are addressed by their bytes on disk (they are not
+        # JSONL rows an auditor could re-hash from content) — the record
+        # pins each artifact's sha256 next to the run_id its spans carry.
+        emit_lineage(
+            "flight_export",
+            content={"kind": "flight_export",
+                     "sha256": sha256_file(args.trace_out)},
+            path=str(args.trace_out), run_id=run_id, runs=config.runs,
+            events=len(log.events),
+        )
+        if args.events_out is not None:
+            emit_lineage(
+                "flight_export",
+                content={"kind": "flight_export",
+                         "sha256": sha256_file(args.events_out)},
+                path=str(args.events_out), run_id=run_id,
+                events=len(log.events),
+            )
     if args.telemetry:
         # Correlate with the span ledger: the trace span carries the SAME
         # run_id as the exported file's otherData.

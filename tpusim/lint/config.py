@@ -156,6 +156,20 @@ _DEFAULT_METRICS_MODULE = "tpusim/metrics.py"
 #: Configs whose SLO objectives (``[tool.tpusim-slo]`` / JSON "objectives")
 #: may only reference registered metric families (JX014).
 _DEFAULT_SLO_CONFIG_FILES = ("pyproject.toml",)
+#: Where the provenance registries (``KINDS``/``INVARIANTS`` tuples) live —
+#: JX020's source of truth for the lineage-record universe.
+_DEFAULT_PROVENANCE_MODULE = "tpusim/provenance.py"
+#: Modules with artifact-producing seams: each must hold at least one
+#: ``emit_lineage(...)`` call, every call's kind must be registered, and
+#: every registered kind must have a call site (JX020).
+_DEFAULT_LINEAGE_WRITER_MODULES = (
+    "tpusim/runner.py",
+    "tpusim/sweep.py",
+    "tpusim/packed.py",
+    "tpusim/fleet.py",
+    "tpusim/perf.py",
+    "tpusim/flight_export.py",
+)
 # -- Concurrency-pass knowledge (tpusim.lint.concurrency, JX015-JX019). -----
 #: Modules that create threads, hold locks, or run in thread context today
 #: (fleet heartbeat, chaos watchdog, metrics HTTP server, bench hard
@@ -185,7 +199,7 @@ _DEFAULT_BLOCKING_CALLS = (
     "serve_forever",
     "sleep",
 )
-_ALL_RULE_IDS = tuple(f"JX{n:03d}" for n in range(1, 20))
+_ALL_RULE_IDS = tuple(f"JX{n:03d}" for n in range(1, 21))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -220,6 +234,8 @@ class LintConfig:
     flag_ignore: tuple[str, ...] = _DEFAULT_FLAG_IGNORE
     metrics_module: str = _DEFAULT_METRICS_MODULE
     slo_config_files: tuple[str, ...] = _DEFAULT_SLO_CONFIG_FILES
+    provenance_module: str = _DEFAULT_PROVENANCE_MODULE
+    lineage_writer_modules: tuple[str, ...] = _DEFAULT_LINEAGE_WRITER_MODULES
     # Concurrency-pass knowledge (JX015-JX019; tpusim.lint.concurrency).
     thread_modules: tuple[str, ...] = _DEFAULT_THREAD_MODULES
     lock_attr_names: tuple[str, ...] = _DEFAULT_LOCK_ATTRS
@@ -275,6 +291,7 @@ def load_config(pyproject: Path | None = None) -> LintConfig:
         ("cli_modules", "cli-modules"),
         ("flag_ignore", "flag-ignore"),
         ("slo_config_files", "slo-config-files"),
+        ("lineage_writer_modules", "lineage-writer-modules"),
         ("thread_modules", "thread-modules"),
         ("lock_attr_names", "lock-attr-names"),
         ("blocking_call_patterns", "blocking-call-patterns"),
@@ -285,4 +302,6 @@ def load_config(pyproject: Path | None = None) -> LintConfig:
         kwargs["span_writer"] = str(block["span-writer"])
     if "metrics-module" in block:
         kwargs["metrics_module"] = str(block["metrics-module"])
+    if "provenance-module" in block:
+        kwargs["provenance_module"] = str(block["provenance-module"])
     return LintConfig(**kwargs)

@@ -1448,6 +1448,198 @@ class ProjectContracts:
                     self._doc_lines(doc),
                 )
 
+    # -- JX020 -------------------------------------------------------------
+    # The provenance contract: the provenance module's KINDS tuple-of-tuples
+    # literal is the artifact-kind source of truth. Every emit_lineage("...")
+    # call site in the declared lineage-writer modules must use a registered
+    # kind, every registered kind must have a live call site, every declared
+    # writer module must actually write — and the INVARIANTS literal must
+    # stay in lockstep with the marker-anchored README audit-invariant
+    # table, both directions (the JX014 discipline, extended to the audit
+    # plane).
+
+    def _provenance_literal(self, m: ModuleFacts, name: str) -> dict[str, int] | None:
+        """name -> registry-element line from a module-level tuple-of-tuples
+        literal, or None when the literal is missing/empty."""
+        for node in m.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name
+                and isinstance(node.value, (ast.Tuple, ast.List))
+            ):
+                out: dict[str, int] = {}
+                for e in node.value.elts:
+                    if isinstance(e, (ast.Tuple, ast.List)) and e.elts:
+                        s = _const_str(e.elts[0])
+                        if s is not None:
+                            out.setdefault(s, e.lineno)
+                return out or None
+        return None
+
+    def _lineage_calls(self, rel: str) -> list[tuple[str | None, int]] | None:
+        """(kind-or-None, line) per ``emit_lineage(...)``/``.emit(kind=...)``
+        writer call in one module; None when the module is missing or
+        unparseable. Matched by NAME (the module-level seam entry point and
+        the bound LineageWriter.emit), so a seam cannot dodge the contract
+        by aliasing the import."""
+        m = self._load(rel)
+        if m is None:
+            return None
+        calls: list[tuple[str | None, int]] = []
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            named = (
+                isinstance(fn, ast.Name) and fn.id == "emit_lineage"
+            ) or (
+                isinstance(fn, ast.Attribute) and fn.attr == "emit_lineage"
+            )
+            if not named:
+                continue
+            kind = _const_str(node.args[0]) if node.args else None
+            calls.append((kind, node.lineno))
+        return calls
+
+    def _readme_invariants(self) -> tuple[dict[str, tuple[str, int]], bool]:
+        """Invariant names from the marker-anchored README audit-invariant
+        table: name -> (doc path, line). Same state machine as the metrics
+        table (invariant names are kebab-case, hence the dash in the row
+        pattern)."""
+        invariants: dict[str, tuple[str, int]] = {}
+        saw_marker = False
+        for doc in self.config.doc_files:
+            lines = self._doc_lines(doc)
+            armed = in_table = False
+            for i, line in enumerate(lines, start=1):
+                if "tpusim-lint: audit-invariant-table" in line:
+                    saw_marker = armed = True
+                    continue
+                is_row = line.lstrip().startswith("|")
+                if armed and is_row:
+                    armed, in_table = False, True
+                if in_table:
+                    mrow = re.match(r"\s*\|\s*`([A-Za-z0-9_.-]+)`\s*\|", line)
+                    if mrow:
+                        invariants.setdefault(mrow.group(1), (doc, i))
+                    elif not is_row:
+                        in_table = False
+        return invariants, saw_marker
+
+    def check_provenance_contract(self) -> Iterator[Finding]:
+        rel = self.config.provenance_module
+        if not rel:
+            return
+        m = self._load(rel)
+        if m is None:
+            yield Finding(
+                "JX020", rel, 1, 0,
+                "configured provenance-module is missing or unparseable — "
+                "the lineage contract has no registry to pin (config drift)",
+            )
+            return
+        kinds = self._provenance_literal(m, "KINDS")
+        invariants = self._provenance_literal(m, "INVARIANTS")
+        if kinds is None or invariants is None:
+            missing = "KINDS" if kinds is None else "INVARIANTS"
+            yield m.finding(
+                "JX020", m.tree,
+                f"no module-level {missing} tuple-of-tuples literal found — "
+                f"the provenance universe must be statically readable for "
+                f"the seam/README cross-check",
+            )
+            return
+        # Direction 1: every writer call uses a registered kind; every
+        # declared writer module actually writes.
+        used: dict[str, tuple[str, int]] = {}
+        for wrel in self.config.lineage_writer_modules:
+            calls = self._lineage_calls(wrel)
+            if calls is None:
+                yield Finding(
+                    "JX020", wrel, 1, 0,
+                    "configured lineage-writer module is missing or "
+                    "unparseable (config drift)",
+                )
+                continue
+            if not calls:
+                yield Finding(
+                    "JX020", wrel, 1, 0,
+                    "declared lineage-writer module has no emit_lineage(...) "
+                    "call site — an artifact-producing seam outside the "
+                    "provenance ledger (wire the seam or drop the module "
+                    "from lineage-writer-modules)",
+                )
+                continue
+            wm = self._load(wrel)
+            for kind, line in calls:
+                text = (
+                    wm.lines[line - 1].strip()
+                    if wm and 0 < line <= len(wm.lines) else ""
+                )
+                if kind is None:
+                    yield Finding(
+                        "JX020", wrel, line, 0,
+                        "emit_lineage kind must be a string literal — a "
+                        "computed kind cannot be cross-checked against the "
+                        "KINDS registry",
+                        text,
+                    )
+                elif kind not in kinds:
+                    yield Finding(
+                        "JX020", wrel, line, 0,
+                        f"emit_lineage kind `{kind}` is not in the KINDS "
+                        f"registry ({rel}) — register it or fix the typo "
+                        f"(the writer raises on it at runtime)",
+                        text,
+                    )
+                else:
+                    used.setdefault(kind, (wrel, line))
+        # Direction 2: every registered kind has a live seam.
+        for kind, line in sorted(kinds.items()):
+            if kind not in used:
+                text = m.lines[line - 1].strip() if 0 < line <= len(m.lines) else ""
+                yield Finding(
+                    "JX020", m.path, line, 0,
+                    f"registered lineage kind `{kind}` has no "
+                    f"emit_lineage call site in the configured writer "
+                    f"modules — dead registry entry or unwired seam",
+                    text,
+                )
+        # Direction 3: INVARIANTS <-> README audit-invariant table, both ways.
+        documented, saw_marker = self._readme_invariants()
+        if not saw_marker:
+            if self.config.doc_files:
+                doc = self.config.doc_files[0]
+                yield self._doc_finding(
+                    "JX020", doc, 1,
+                    "no `tpusim-lint: audit-invariant-table` marker found in "
+                    "the doc files — the audit invariant table cannot be "
+                    "cross-checked (add the marker comment above it)",
+                    self._doc_lines(doc),
+                )
+            return
+        for inv, line in sorted(invariants.items()):
+            if inv not in documented:
+                text = m.lines[line - 1].strip() if 0 < line <= len(m.lines) else ""
+                yield Finding(
+                    "JX020", m.path, line, 0,
+                    f"audit invariant `{inv}` is missing from the documented "
+                    f"invariant table — an unexplained gate failure nobody "
+                    f"can look up",
+                    text,
+                )
+        for inv, (doc, line) in sorted(documented.items()):
+            if inv not in invariants:
+                yield self._doc_finding(
+                    "JX020", doc, line,
+                    f"documented audit invariant `{inv}` is verified by no "
+                    f"INVARIANTS entry in {rel} — stale table row or renamed "
+                    f"invariant",
+                    self._doc_lines(doc),
+                )
+
 
 # ---------------------------------------------------------------------------
 # Registry + entry point (mirrors rules.ALL_RULES for the project scope).
@@ -1476,6 +1668,12 @@ CONTRACT_RULES: dict[str, tuple[ContractFn, str]] = {
         ProjectContracts.check_metrics_contract,
         "SLO-config metric absent from the metrics registry, or registry/"
         "README metrics-table drift",
+    ),
+    "JX020": (
+        ProjectContracts.check_provenance_contract,
+        "lineage kind emitted but unregistered (or registered but never "
+        "emitted); writer module without a seam; audit-invariant README "
+        "table drift",
     ),
 }
 

@@ -70,6 +70,12 @@ import numpy as np
 from .config import SimConfig
 from .convergence import STATS as MOMENT_STATS
 from .convergence import MomentAccumulator, moment_keys
+from .provenance import (
+    checkpoint_address,
+    checkpoint_content,
+    emit_lineage,
+    lineage_armed,
+)
 from .stats import SimResults
 
 logger = logging.getLogger("tpusim")
@@ -686,6 +692,29 @@ def _run_grid_dispatches(
                 "resuming packed point %s from checkpoint at %d/%d runs",
                 names[i], done[i], cfg.runs,
             )
+            if lineage_armed():
+                # Load-side attestation first (the runner discipline): a kill
+                # inside the saving process's ckpt.save leaves the checkpoint
+                # durable but unrecorded; the loader re-attests the same
+                # deterministic content address so the cite always resolves.
+                ck_addr = emit_lineage(
+                    "checkpoint",
+                    content=checkpoint_content(ck.fingerprint, done[i]),
+                    config_fingerprint=ck.fingerprint, runs_done=done[i],
+                    path=str(ck.path), point=names[i], attested="load",
+                )
+                # key= files the load under the point name, so the row
+                # sweep.emit_row eventually emits for this point cites the
+                # checkpoint it healed from — the packed path has no per-run
+                # "run" record to chain through.
+                emit_lineage(
+                    "checkpoint_load",
+                    parents=(ck_addr
+                             or checkpoint_address(ck.fingerprint, done[i]),),
+                    config_fingerprint=ck.fingerprint, runs_done=done[i],
+                    path=str(ck.path), point=names[i], packed=True,
+                    key=names[i],
+                )
             if telemetry is not None:
                 dur_ld = time.perf_counter() - t_ld
                 telemetry.emit(
@@ -755,6 +784,16 @@ def _run_grid_dispatches(
             for pt in sorted({p.point for p in batch}):
                 t_ck = time.perf_counter()
                 ckpts[pt].save(done[pt], state[pt]["sums"])
+                if lineage_armed():
+                    emit_lineage(
+                        "checkpoint",
+                        content=checkpoint_content(
+                            ckpts[pt].fingerprint, done[pt]
+                        ),
+                        config_fingerprint=ckpts[pt].fingerprint,
+                        runs_done=done[pt], path=str(ckpts[pt].path),
+                        point=names[pt],
+                    )
                 if telemetry is not None:
                     dur_ck = time.perf_counter() - t_ck
                     telemetry.emit(

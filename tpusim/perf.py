@@ -130,18 +130,41 @@ def _git_rev() -> str | None:
         return None
 
 
+def _git_dirty() -> bool | None:
+    """Whether the working tree has uncommitted changes — None when git (or
+    the repo) is unavailable, same tolerance as :func:`_git_rev`. Recorded
+    next to ``git_rev``: an uncommitted tree stamping a clean-looking rev
+    into the perf ledger silently poisons trajectory comparisons."""
+    try:
+        out = subprocess.run(
+            ["git", "status", "--porcelain"],
+            cwd=Path(__file__).resolve().parents[1],
+            capture_output=True, text=True, timeout=10,
+        )
+        if out.returncode != 0:
+            return None
+        return bool(out.stdout.strip())
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
 def environment_fingerprint() -> dict[str, Any]:
     """The row's environment identity: everything needed to judge whether
     two rows are comparable at all (the ROADMAP's drift note — CPU numbers
     from different hosts/jax versions are NOT comparable — as machine-read
     fields instead of prose). Extends telemetry.environment_attrs with the
-    host and revision facts a benchmark row needs."""
+    host and revision facts a benchmark row needs. Shared with the
+    provenance plane (tpusim.provenance): lineage records carry the same
+    rev + dirty-flag identity, so `tpusim audit` can cross-check the two."""
     env = dict(environment_attrs())
     env["cpu_count"] = os.cpu_count()
     env["date"] = time.strftime("%Y-%m-%dT%H:%M:%S")
     rev = _git_rev()
     if rev is not None:
         env["git_rev"] = rev
+        dirty = _git_dirty()
+        if dirty is not None:
+            env["git_dirty"] = dirty
     return env
 
 
@@ -206,7 +229,15 @@ def validate_row(row: Any) -> None:
 
 
 def append_rows(path: str | Path, rows: list[dict]) -> None:
-    """Validate and append rows to an append-only JSONL ledger."""
+    """Validate and append rows to an append-only JSONL ledger.
+
+    THE perf-row write seam: every producer (the `perf run` CLI,
+    scripts/loadgen.py) lands here, so the armed provenance plane records
+    each appended row exactly once — one lineage record per row, citing the
+    run record of the measurement that produced it (the scenarios dispatch
+    through run_simulation_config, which records itself when armed).
+    Content-addressed over the exact dict written, so the ledger line
+    re-hashes to the same address."""
     for row in rows:
         validate_row(row)
     path = Path(path)
@@ -214,6 +245,15 @@ def append_rows(path: str | Path, rows: list[dict]) -> None:
     with path.open("a") as fh:
         for row in rows:
             fh.write(json.dumps(row) + "\n")
+    from .provenance import emit_lineage, lineage_armed, lineage_last
+
+    if lineage_armed():
+        for row in rows:
+            emit_lineage(
+                "perf_row", content=row,
+                parents=(lineage_last("run"),),
+                scenario=row["scenario"], metric=row["metric"],
+            )
 
 
 def load_rows(path: str | Path) -> list[dict]:

@@ -1,0 +1,1072 @@
+"""Provenance & audit plane: content-addressed result lineage, the
+cross-plane consistency gate (``tpusim audit``), and sealed evidence bundles.
+
+The repo's six observability planes (telemetry spans, flight rings,
+convergence moments, perf ledger, trace trees, metrics/SLO) each record
+*that* things happened; none records *what produced what*, and nothing
+cross-checks them against each other — a healed fleet row, a perf
+trajectory point and a sweep JSONL line are all anonymous JSON. This module
+(jax-free, like telemetry/metrics/fleet) is the missing ledger:
+
+  * **Lineage records.** Every artifact-producing seam — runner run
+    completion, sweep rows (sequential AND packed), fleet worker rows,
+    ``perf run`` rows, checkpoint save/load, flight/trace exports — appends
+    one content-addressed record to an append-only lineage ledger via the
+    shared torn-line-repairing :func:`tpusim.telemetry.append_jsonl_line`
+    (fsync'd: a SIGKILL cannot tear the provenance chain mid-record). A
+    record's ``content_sha256`` is the sha256 of the artifact's canonical
+    JSON — the address rows resolve to and parents cite — and its
+    ``artifact_id`` is the sha256 of the whole record, so a mutated ledger
+    line fails its own hash. ``parents`` form the lineage DAG: a
+    resumed-from-checkpoint row cites the checkpoint it healed from
+    (checkpoint addresses are deterministic over ``(fingerprint,
+    runs_done)``, so a replacement fleet worker resolves the dead worker's
+    save without ever reading the ledger), and a perf row cites the run
+    that measured it.
+  * **``tpusim audit``** — joins lineage + telemetry spans + fleet ledger +
+    perf ledger + checkpoints and verifies the :data:`INVARIANTS` the
+    planes already imply, with the perf-compare/SLO exit discipline
+    (0 pass / 1 violation / 2 structural-or-dead-gate; an EMPTY lineage
+    ledger can never pass green).
+  * **``tpusim lineage show``** — walk one artifact's parent chain
+    (row → run → checkpoint_load → checkpoint) as a terminal tree.
+  * **``tpusim bundle create|verify``** — a sealed evidence tarball
+    (ledgers + a manifest of per-file sha256 hashes) that ``verify``
+    re-hashes fully offline; a flipped byte fails loud.
+
+Arming is environment-scoped: setting :data:`PROVENANCE_ENV`
+(``TPUSIM_PROVENANCE``) to a ledger path arms every seam in the process AND
+its children (fleet workers inherit it, so one ledger spans the whole
+fleet). Unset, every seam is a host-side no-op behind
+:func:`lineage_armed` — nothing is traced, the compiled device programs are
+byte-identical and warmed dispatch stays at zero recompiles (pinned by
+tests/test_provenance.py, the chaos/flight zero-overhead discipline).
+
+    TPUSIM_PROVENANCE=artifacts/provenance/lineage.jsonl \\
+        python -m tpusim.sweep propagation --out rows.jsonl
+    python -m tpusim audit . --lineage artifacts/provenance/lineage.jsonl
+    python -m tpusim lineage show rows.jsonl --lineage artifacts/provenance/lineage.jsonl
+    python -m tpusim bundle create evidence.tar rows.jsonl artifacts/provenance
+    python -m tpusim bundle verify evidence.tar
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import io
+import json
+import logging
+import os
+import sys
+import tarfile
+import time
+from pathlib import Path
+from typing import Any, Iterable
+
+from .telemetry import append_jsonl_line
+
+logger = logging.getLogger("tpusim")
+
+__all__ = [
+    "PROVENANCE_ENV",
+    "SCHEMA",
+    "KINDS",
+    "INVARIANTS",
+    "LineageWriter",
+    "canonical_json",
+    "content_address",
+    "checkpoint_content",
+    "checkpoint_address",
+    "sha256_file",
+    "lineage_armed",
+    "active_writer",
+    "emit_lineage",
+    "lineage_last",
+    "lineage_note_parents",
+    "lineage_take_parents",
+    "load_lineage",
+    "summarize_lineage",
+    "run_audit",
+    "audit_main",
+    "lineage_main",
+    "bundle_main",
+]
+
+#: Environment variable naming the lineage ledger path. Set = every
+#: artifact-producing seam in this process (and its subprocesses — fleet
+#: workers inherit the environment) appends records there; unset = every
+#: seam is a no-op.
+PROVENANCE_ENV = "TPUSIM_PROVENANCE"
+
+#: Lineage record schema version.
+SCHEMA = 1
+
+#: The artifact-kind registry: ``(kind, help)`` per kind — the ONE place
+#: the lineage-record vocabulary is declared. ``tpusim lint`` (JX020) pins
+#: this tuple against the live ``emit_lineage("...")`` call sites in the
+#: configured lineage-writer modules, both directions, so an
+#: artifact-producing seam cannot be added (or renamed) without the
+#: registry — and the audit gate — knowing about it.
+KINDS = (
+    ("run", "one run_simulation_config completion (content: the result dict)"),
+    ("sweep_row", "one sweep output row, sequential or packed (content: the row)"),
+    ("fleet_row", "a single-point fleet worker's published row (content: the row)"),
+    ("perf_row", "one perf-ledger benchmark row (content: the row)"),
+    ("checkpoint", "a durable checkpoint save (content: fingerprint + runs_done)"),
+    ("checkpoint_load", "a checkpoint resume, citing the checkpoint it loaded"),
+    ("flight_export", "an exported flight/trace artifact (content: the file sha256)"),
+)
+
+#: The cross-plane invariants ``tpusim audit`` verifies: ``(name, help)``
+#: per invariant. Mirrored by the marker-anchored README audit-invariant
+#: table (``tpusim-lint: audit-invariant-table``), pinned both directions
+#: by JX020 — an invariant without a doc row, or a doc row without an
+#: implementation, fails the lint gate.
+INVARIANTS = (
+    ("record-hash",
+     "every lineage record re-hashes to its own artifact_id"),
+    ("parent-resolvable",
+     "every cited parent address resolves to a lineage record"),
+    ("row-lineage",
+     "every result/perf row resolves by content hash to a lineage record"),
+    ("runs-consistent",
+     "rows' runs match their lineage records; closing-span run totals "
+     "match the lineage run records of the same run_id"),
+    ("checkpoint-fingerprint",
+     "every checkpoint npz's embedded fingerprint has a matching lineage "
+     "checkpoint record"),
+    ("heal-parented",
+     "a fleet-healed (requeued then done) state dir has a row whose parent "
+     "chain reaches the checkpoint it resumed from"),
+    ("env-rev",
+     "a perf row's recorded git rev/dirty flag matches its lineage record"),
+)
+
+_KIND_NAMES = tuple(k for k, _ in KINDS)
+
+
+# ---------------------------------------------------------------------------
+# Content addressing.
+
+
+def canonical_json(obj: Any) -> str:
+    """The one canonical serialization content addresses are computed over:
+    sorted keys, no whitespace. Key-order and formatting differences between
+    a row as written and a row as re-read therefore never change its
+    address; any VALUE change does."""
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+def content_address(obj: Any) -> str:
+    """sha256 hex of ``obj``'s canonical JSON — the content address rows
+    resolve to and parents cite."""
+    return hashlib.sha256(canonical_json(obj).encode()).hexdigest()
+
+
+def checkpoint_content(fingerprint: str, runs_done: int) -> dict[str, Any]:
+    """The canonical content of one durable checkpoint save. Deterministic
+    over ``(fingerprint, runs_done)`` so a LOADER — possibly a replacement
+    fleet worker in a different process — recomputes the saved checkpoint's
+    address without reading the ledger."""
+    return {
+        "kind": "checkpoint",
+        "fingerprint": fingerprint,
+        "runs_done": int(runs_done),
+    }
+
+
+def checkpoint_address(fingerprint: str, runs_done: int) -> str:
+    return content_address(checkpoint_content(fingerprint, runs_done))
+
+
+def sha256_file(path: str | Path) -> str:
+    h = hashlib.sha256()
+    with Path(path).open("rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _record_id(rec: dict[str, Any]) -> str:
+    """A record's own tamper-evident hash: sha256 over the full record
+    minus ``artifact_id`` itself."""
+    return content_address({k: v for k, v in rec.items() if k != "artifact_id"})
+
+
+# ---------------------------------------------------------------------------
+# The writer.
+
+
+class LineageWriter:
+    """Append-only lineage ledger writer. All host-side, jax-free; writes go
+    through the shared torn-line repair (:func:`append_jsonl_line`) with
+    fsync-on-append, so a record either survives a SIGKILL whole or was
+    never acknowledged — the provenance chain is never torn mid-record.
+
+    Besides writing, the writer carries two bits of in-process joining
+    state the seams use to build the DAG without threading artifact ids
+    through every call signature: ``last(kind)`` (the newest address
+    emitted under a kind — how a sweep row finds the run that produced it)
+    and a parent mailbox keyed by point name (how a packed resume hands its
+    checkpoint_load address to the row emitted later).
+
+    A failed write degrades like telemetry (warn once, disarm the writer,
+    the run continues) — and fails LOUD downstream instead: the missing
+    records turn `tpusim audit` red."""
+
+    def __init__(self, path: str | Path, *, fsync: bool = True):
+        self.path = Path(path)
+        self.fsync = fsync
+        self.disabled = False
+        self._env: dict[str, Any] | None = None
+        self._last: dict[str, str] = {}
+        self._parents: dict[str, list[str]] = {}
+
+    def _env_attrs(self) -> dict[str, Any]:
+        # Cached once per writer: the env fingerprint shells out to git.
+        if self._env is None:
+            from .perf import environment_fingerprint
+
+            env = environment_fingerprint()
+            self._env = {
+                "git_rev": env.get("git_rev"),
+                "git_dirty": env.get("git_dirty"),
+                "env_sha256": content_address(env),
+            }
+        return self._env
+
+    def emit(
+        self,
+        kind: str,
+        *,
+        content: Any = None,
+        parents: Iterable[str | None] = (),
+        key: str | None = None,
+        **attrs: Any,
+    ) -> str | None:
+        """Append one lineage record; returns the artifact's address (its
+        ``content_sha256`` when ``content`` is given, its ``artifact_id``
+        otherwise), or None when the writer is disarmed. ``key`` also files
+        the address in the parent mailbox under that key."""
+        if kind not in _KIND_NAMES:
+            raise ValueError(f"unknown lineage kind {kind!r}; register it in KINDS")
+        if self.disabled:
+            return None
+        addr = content_address(content) if content is not None else None
+        rec: dict[str, Any] = {
+            "schema": SCHEMA,
+            "kind": kind,
+            "t": round(time.time(), 3),
+            "content_sha256": addr,
+            "parents": [p for p in parents if p],
+            **self._env_attrs(),
+            **{k: v for k, v in attrs.items() if v is not None},
+        }
+        rec["artifact_id"] = _record_id(rec)
+        out = addr or rec["artifact_id"]
+        try:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            append_jsonl_line(self.path, json.dumps(rec), fsync=self.fsync)
+        except OSError as e:
+            # The telemetry ENOSPC discipline: warn once, disarm, keep the
+            # run alive. The gap fails loud later — audit can't resolve the
+            # rows this writer stopped recording.
+            self.disabled = True
+            logger.warning(
+                "disabling lineage ledger %s after write failure (%s: %s); "
+                "`tpusim audit` over these artifacts will fail",
+                self.path, type(e).__name__, e,
+            )
+            return None
+        self._last[kind] = out
+        if key is not None:
+            self._parents.setdefault(key, []).append(out)
+        return out
+
+    def last(self, kind: str) -> str | None:
+        return self._last.get(kind)
+
+    def note_parents(self, key: str, *addrs: str | None) -> None:
+        good = [a for a in addrs if a]
+        if good:
+            self._parents.setdefault(key, []).extend(good)
+
+    def take_parents(self, key: str) -> list[str]:
+        return self._parents.pop(key, [])
+
+
+_WRITERS: dict[str, LineageWriter] = {}
+
+
+def lineage_armed() -> bool:
+    """Whether the provenance plane is armed for this process. The seams
+    guard on this (the ``if chaos is not None`` discipline) so a disarmed
+    run pays nothing — not even argument construction."""
+    return bool(os.environ.get(PROVENANCE_ENV))
+
+
+def active_writer() -> LineageWriter | None:
+    """The process-wide writer for the env-armed ledger path (one per
+    distinct path, cached so ``last``/mailbox state joins records across
+    modules), or None when disarmed."""
+    path = os.environ.get(PROVENANCE_ENV)
+    if not path:
+        return None
+    w = _WRITERS.get(path)
+    if w is None:
+        w = _WRITERS[path] = LineageWriter(path)
+    return w
+
+
+def emit_lineage(
+    kind: str,
+    *,
+    content: Any = None,
+    parents: Iterable[str | None] = (),
+    key: str | None = None,
+    **attrs: Any,
+) -> str | None:
+    """Module-level seam entry point: append one record to the env-armed
+    ledger (no-op returning None when disarmed). THE call every
+    artifact-producing seam makes — ``tpusim lint`` (JX020) statically
+    cross-checks these call sites against :data:`KINDS`."""
+    w = active_writer()
+    if w is None:
+        return None
+    return w.emit(kind, content=content, parents=parents, key=key, **attrs)
+
+
+def lineage_last(kind: str) -> str | None:
+    w = active_writer()
+    return None if w is None else w.last(kind)
+
+
+def lineage_note_parents(key: str, *addrs: str | None) -> None:
+    w = active_writer()
+    if w is not None:
+        w.note_parents(key, *addrs)
+
+
+def lineage_take_parents(key: str) -> list[str]:
+    w = active_writer()
+    return [] if w is None else w.take_parents(key)
+
+
+# ---------------------------------------------------------------------------
+# Loaders.
+
+
+def load_lineage(path: str | Path, *, strict: bool = False) -> list[dict]:
+    """Read a lineage ledger back. Tolerant by default (skip torn/foreign
+    lines — the load_spans policy, since a live writer may still be
+    appending); ``strict=True`` raises ValueError with ``path:line`` on any
+    unparseable line or any record whose ``artifact_id`` does not re-hash
+    (the harvest validator: collected evidence must be whole)."""
+    path = Path(path)
+    records: list[dict] = []
+    if not path.exists():
+        if strict:
+            raise ValueError(f"{path}: lineage ledger does not exist")
+        return records
+    for i, line in enumerate(
+        path.read_text(errors="replace").splitlines(), start=1
+    ):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            if strict:
+                raise ValueError(f"{path}:{i}: unparseable lineage line")
+            continue
+        if not isinstance(rec, dict) or "artifact_id" not in rec or "kind" not in rec:
+            if strict:
+                raise ValueError(f"{path}:{i}: not a lineage record: {line[:80]}")
+            continue
+        if strict and _record_id(rec) != rec["artifact_id"]:
+            raise ValueError(
+                f"{path}:{i}: lineage record fails its own hash "
+                f"(artifact_id {str(rec['artifact_id'])[:12]}…) — mutated ledger"
+            )
+        records.append(rec)
+    return records
+
+
+def summarize_lineage(records: list[dict]) -> dict[str, Any] | None:
+    """Digest a lineage ledger into the one summary dict both dashboards
+    render (the summarize_fleet_spans discipline): record/kind counts, DAG
+    edge count, newest record time. None when there are no records."""
+    if not records:
+        return None
+    kinds: dict[str, int] = {}
+    edges = 0
+    newest = 0.0
+    dirty = 0
+    for rec in records:
+        kinds[str(rec.get("kind"))] = kinds.get(str(rec.get("kind")), 0) + 1
+        parents = rec.get("parents")
+        edges += len(parents) if isinstance(parents, list) else 0
+        t = rec.get("t")
+        if isinstance(t, (int, float)):
+            newest = max(newest, float(t))
+        if rec.get("git_dirty"):
+            dirty += 1
+    return {
+        "records": len(records),
+        "kinds": kinds,
+        "edges": edges,
+        "newest_t": newest or None,
+        "dirty_records": dirty,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Artifact scanning: classify everything under the audited roots.
+
+
+def _classify_jsonl_line(row: Any) -> str | None:
+    """Which plane one parsed JSONL object belongs to. Foreign/partial
+    objects classify as None and are skipped — every plane's own loaders
+    are tolerant, and the audit join must be too."""
+    if not isinstance(row, dict):
+        return None
+    if "artifact_id" in row and "kind" in row:
+        return "lineage"
+    if isinstance(row.get("span"), str):
+        return "span"
+    if isinstance(row.get("event"), str):
+        return "event"
+    if "scenario" in row and "metric" in row and "samples" in row:
+        return "perf_row"
+    if (
+        "point" in row and "runs" in row and "backend" in row
+        and "elapsed_s" in row
+    ):
+        return "result_row"
+    return None
+
+
+def _checkpoint_fingerprint_of(path: Path) -> str | None:
+    """The ``__config__`` fingerprint embedded in one checkpoint npz, or
+    None when the file is unreadable/foreign (a torn checkpoint is a
+    *recoverable* runtime condition — the runner restarts from zero — so
+    audit skips it rather than failing)."""
+    try:
+        import numpy as np
+
+        with np.load(path, allow_pickle=False) as data:
+            if "__config__" not in data.files:
+                return None
+            return str(data["__config__"])
+    except Exception:  # torn zip, foreign npz, missing numpy
+        return None
+
+
+def scan_artifacts(
+    roots: list[Path], lineage_paths: list[Path] | None = None
+) -> dict[str, Any]:
+    """Walk ``roots`` (files or directories) and bucket everything found:
+    lineage records, result rows, perf rows, telemetry spans, fleet event
+    ledgers, checkpoint fingerprints. Returns the scan dict ``run_audit``
+    consumes."""
+    jsonl: list[Path] = []
+    npz: list[Path] = []
+    for root in roots:
+        if root.is_dir():
+            jsonl.extend(sorted(root.rglob("*.jsonl")))
+            npz.extend(sorted(root.rglob("*.npz")))
+        elif root.suffix == ".jsonl":
+            jsonl.append(root)
+        elif root.suffix == ".npz":
+            npz.append(root)
+    for extra in lineage_paths or []:
+        if extra not in jsonl and extra.exists():
+            jsonl.append(extra)
+
+    scan: dict[str, Any] = {
+        "lineage": [],        # records
+        "lineage_files": [],
+        "result_rows": [],    # (path, lineno, row)
+        "perf_rows": [],      # (path, lineno, row)
+        "spans": [],
+        "fleet_ledgers": {},  # path -> [events]
+        "checkpoints": [],    # (path, fingerprint)
+        "files": len(jsonl) + len(npz),
+    }
+    for path in jsonl:
+        try:
+            text = path.read_text(errors="replace")
+        except OSError:
+            continue
+        saw_lineage = False
+        for i, line in enumerate(text.splitlines(), start=1):
+            if not line.strip():
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn trailing line: tolerated, like every loader
+            plane = _classify_jsonl_line(row)
+            if plane == "lineage":
+                scan["lineage"].append(row)
+                saw_lineage = True
+            elif plane == "span":
+                scan["spans"].append(row)
+            elif plane == "event":
+                scan["fleet_ledgers"].setdefault(path, []).append(row)
+            elif plane == "perf_row":
+                scan["perf_rows"].append((path, i, row))
+            elif plane == "result_row":
+                scan["result_rows"].append((path, i, row))
+        if saw_lineage:
+            scan["lineage_files"].append(path)
+    for path in npz:
+        if path.name.endswith(".tmp.npz"):
+            continue  # swept, never adopted — not an artifact
+        fp = _checkpoint_fingerprint_of(path)
+        if fp is not None:
+            scan["checkpoints"].append((path, fp))
+    return scan
+
+
+# ---------------------------------------------------------------------------
+# The audit gate.
+
+
+def _ancestor_kinds(
+    addr: str, by_addr: dict[str, dict], limit: int = 10000
+) -> set[str]:
+    """Kinds reachable through the parent DAG from one address (cycle- and
+    depth-guarded: a mutated ledger must not hang the auditor)."""
+    kinds: set[str] = set()
+    seen: set[str] = set()
+    stack = [addr]
+    while stack and len(seen) < limit:
+        cur = stack.pop()
+        if cur in seen:
+            continue
+        seen.add(cur)
+        rec = by_addr.get(cur)
+        if rec is None:
+            continue
+        kinds.add(str(rec.get("kind")))
+        parents = rec.get("parents")
+        if isinstance(parents, list):
+            stack.extend(str(p) for p in parents)
+    return kinds
+
+
+def run_audit(scan: dict[str, Any]) -> tuple[list[tuple[str, str]], dict[str, int]]:
+    """Verify :data:`INVARIANTS` over one artifact scan. Returns
+    ``(violations, checked)``: violations as ``(invariant, message)`` pairs
+    and the per-invariant count of facts checked (a zero-checked invariant
+    simply had no joinable facts in this artifact set)."""
+    violations: list[tuple[str, str]] = []
+    checked = {name: 0 for name, _ in INVARIANTS}
+    records: list[dict] = scan["lineage"]
+
+    by_addr: dict[str, dict] = {}
+    by_fingerprint_ckpt: set[str] = set()
+    for rec in records:
+        addr = rec.get("content_sha256") or rec.get("artifact_id")
+        if isinstance(addr, str):
+            by_addr.setdefault(addr, rec)
+        aid = rec.get("artifact_id")
+        if isinstance(aid, str):
+            by_addr.setdefault(aid, rec)
+        if rec.get("kind") == "checkpoint" and isinstance(
+            rec.get("config_fingerprint"), str
+        ):
+            by_fingerprint_ckpt.add(rec["config_fingerprint"])
+
+    # record-hash: a mutated ledger line fails its own hash.
+    for rec in records:
+        checked["record-hash"] += 1
+        if _record_id(rec) != rec.get("artifact_id"):
+            violations.append((
+                "record-hash",
+                f"lineage record {str(rec.get('artifact_id'))[:12]}… "
+                f"(kind {rec.get('kind')}) does not re-hash to its "
+                f"artifact_id — mutated ledger line",
+            ))
+
+    # parent-resolvable: the DAG has no dangling edges.
+    for rec in records:
+        parents = rec.get("parents")
+        if not isinstance(parents, list):
+            continue
+        for p in parents:
+            checked["parent-resolvable"] += 1
+            if str(p) not in by_addr:
+                violations.append((
+                    "parent-resolvable",
+                    f"record {str(rec.get('artifact_id'))[:12]}… (kind "
+                    f"{rec.get('kind')}) cites parent {str(p)[:12]}… which "
+                    f"no lineage record resolves",
+                ))
+
+    # row-lineage: every row on disk resolves by content hash.
+    row_addr: dict[int, str] = {}
+    for plane in ("result_rows", "perf_rows"):
+        for path, lineno, row in scan[plane]:
+            checked["row-lineage"] += 1
+            addr = content_address(row)
+            row_addr[id(row)] = addr
+            if addr not in by_addr:
+                label = row.get("point") or row.get("scenario") or "?"
+                violations.append((
+                    "row-lineage",
+                    f"{path}:{lineno}: row ({label}) has no lineage record "
+                    f"for content address {addr[:12]}… — unrecorded or "
+                    f"mutated artifact",
+                ))
+
+    # runs-consistent, part 1: a row's runs equals its lineage record's.
+    for path, lineno, row in scan["result_rows"]:
+        rec = by_addr.get(row_addr.get(id(row), ""))
+        if rec is None or "runs" not in rec:
+            continue
+        checked["runs-consistent"] += 1
+        if rec.get("runs") != row.get("runs"):
+            violations.append((
+                "runs-consistent",
+                f"{path}:{lineno}: row runs={row.get('runs')} but its "
+                f"lineage record says runs={rec.get('runs')}",
+            ))
+    # runs-consistent, part 2: closing-span totals vs lineage run records,
+    # joined by run_id (packed closing spans carry no runs attr and fleet
+    # closing spans carry fleet=True — both sides exclude them).
+    span_runs: dict[str, int] = {}
+    for sp in scan["spans"]:
+        if sp.get("span") != "run":
+            continue
+        attrs = sp.get("attrs") or {}
+        rid = sp.get("run_id")
+        runs = attrs.get("runs")
+        if attrs.get("fleet") or not isinstance(rid, str):
+            continue
+        if isinstance(runs, int) and not isinstance(runs, bool):
+            span_runs[rid] = span_runs.get(rid, 0) + runs
+    rec_runs: dict[str, int] = {}
+    for rec in records:
+        if rec.get("kind") != "run":
+            continue
+        rid = rec.get("run_id")
+        runs = rec.get("runs")
+        if isinstance(rid, str) and isinstance(runs, int):
+            rec_runs[rid] = rec_runs.get(rid, 0) + runs
+    for rid in sorted(set(span_runs) & set(rec_runs)):
+        checked["runs-consistent"] += 1
+        if span_runs[rid] != rec_runs[rid]:
+            violations.append((
+                "runs-consistent",
+                f"run_id {rid}: closing run spans total {span_runs[rid]} "
+                f"runs but lineage run records total {rec_runs[rid]}",
+            ))
+
+    # checkpoint-fingerprint: every durable npz is known to the ledger.
+    for path, fp in scan["checkpoints"]:
+        checked["checkpoint-fingerprint"] += 1
+        if fp not in by_fingerprint_ckpt:
+            violations.append((
+                "checkpoint-fingerprint",
+                f"{path}: checkpoint fingerprint has no matching lineage "
+                f"checkpoint record — save seam bypassed the ledger",
+            ))
+
+    # heal-parented: a requeued-then-done fleet state dir (with at least
+    # one durable checkpoint recorded — a pre-first-save kill legitimately
+    # restarts from zero, parentless) must have a row whose chain reaches
+    # the checkpoint it resumed from.
+    for ledger_path, events in scan["fleet_ledgers"].items():
+        requeued = {
+            e.get("point") for e in events if e.get("event") == "requeue"
+        }
+        done = {e.get("point") for e in events if e.get("event") == "done"}
+        healed = {p for p in requeued & done if p}
+        if not healed or not by_fingerprint_ckpt:
+            continue
+        checked["heal-parented"] += 1
+        state_dir = ledger_path.parent
+        reaches = False
+        for path, _, row in scan["result_rows"]:
+            if state_dir not in path.parents and path.parent != state_dir:
+                continue
+            rec = by_addr.get(row_addr.get(id(row), ""))
+            if rec is None:
+                continue
+            kinds = _ancestor_kinds(
+                rec.get("content_sha256") or rec.get("artifact_id"), by_addr
+            )
+            if "checkpoint" in kinds or "checkpoint_load" in kinds:
+                reaches = True
+                break
+        if not reaches:
+            violations.append((
+                "heal-parented",
+                f"{ledger_path}: point(s) {sorted(map(str, healed))} were "
+                f"requeued and healed but no row's parent chain reaches a "
+                f"checkpoint record — the heal lineage is broken",
+            ))
+
+    # env-rev: the perf ledger and the lineage ledger agree on code identity.
+    for path, lineno, row in scan["perf_rows"]:
+        rec = by_addr.get(row_addr.get(id(row), ""))
+        if rec is None:
+            continue
+        env = row.get("env") if isinstance(row.get("env"), dict) else {}
+        checked["env-rev"] += 1
+        if env.get("git_rev") != rec.get("git_rev") or bool(
+            env.get("git_dirty")
+        ) != bool(rec.get("git_dirty")):
+            violations.append((
+                "env-rev",
+                f"{path}:{lineno}: perf row env records rev "
+                f"{env.get('git_rev')!r} (dirty={env.get('git_dirty')!r}) "
+                f"but its lineage record says {rec.get('git_rev')!r} "
+                f"(dirty={rec.get('git_dirty')!r})",
+            ))
+
+    return violations, checked
+
+
+# ---------------------------------------------------------------------------
+# CLI: `tpusim audit`.
+
+
+def _find_lineage_paths(roots: list[Path], explicit: Path | None) -> list[Path]:
+    if explicit is not None:
+        return [explicit]
+    found: list[Path] = []
+    env = os.environ.get(PROVENANCE_ENV)
+    if env and Path(env).exists():
+        found.append(Path(env))
+    for root in roots:
+        if root.is_dir():
+            found.extend(sorted(root.rglob("lineage.jsonl")))
+        elif root.name == "lineage.jsonl":
+            found.append(root)
+    return found
+
+
+def audit_main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpusim audit",
+        description="Cross-plane consistency gate: join the lineage ledger "
+        "with telemetry spans, the fleet work ledger, the perf ledger and "
+        "checkpoints, and verify the provenance invariants (exit 0 pass / "
+        "1 violation / 2 structural-or-dead-gate).",
+    )
+    ap.add_argument(
+        "paths", nargs="+", type=Path,
+        help="artifact roots to audit: state dirs and/or ledger files "
+        "(scanned recursively for *.jsonl and *.npz)",
+    )
+    ap.add_argument(
+        "--lineage", type=Path, metavar="JSONL",
+        help="the lineage ledger (default: $TPUSIM_PROVENANCE plus every "
+        "lineage.jsonl found under the audited roots)",
+    )
+    ap.add_argument("--quiet", action="store_true", help="suppress the summary table")
+    args = ap.parse_args(argv)
+
+    missing = [p for p in args.paths if not p.exists()]
+    if missing:
+        print(
+            f"error: no such artifact root(s): "
+            f"{', '.join(str(p) for p in missing)} (a gate over nothing is "
+            f"a dead gate)", file=sys.stderr,
+        )
+        return 2
+    lineage_paths = _find_lineage_paths(args.paths, args.lineage)
+    scan = scan_artifacts(args.paths, lineage_paths)
+    if not scan["lineage"]:
+        print(
+            "error: no lineage records found "
+            f"({', '.join(str(p) for p in lineage_paths) or 'no ledger located'})"
+            " — an empty lineage ledger can never pass green (dead gate)",
+            file=sys.stderr,
+        )
+        return 2
+
+    violations, checked = run_audit(scan)
+    if not args.quiet:
+        from .report import text_table
+
+        by_inv: dict[str, int] = {}
+        for name, _ in violations:
+            by_inv[name] = by_inv.get(name, 0) + 1
+        rows = [
+            [name, str(checked[name]), str(by_inv.get(name, 0)),
+             "FAIL" if by_inv.get(name) else ("ok" if checked[name] else "—")]
+            for name, _ in INVARIANTS
+        ]
+        print("\n".join(text_table(
+            ["invariant", "checked", "violations", "status"], rows
+        )))
+        summary = summarize_lineage(scan["lineage"]) or {}
+        print(
+            f"[audit] {summary.get('records', 0)} lineage record(s), "
+            f"{len(scan['result_rows'])} result row(s), "
+            f"{len(scan['perf_rows'])} perf row(s), "
+            f"{len(scan['spans'])} span(s), "
+            f"{len(scan['checkpoints'])} checkpoint(s) "
+            f"across {scan['files']} file(s)"
+        )
+    if violations:
+        for name, msg in violations:
+            print(f"error: [{name}] {msg}", file=sys.stderr)
+        print(f"error: {len(violations)} provenance violation(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# CLI: `tpusim lineage show`.
+
+
+def _resolve_target(
+    target: str, line: int | None, by_addr: dict[str, dict]
+) -> tuple[str, dict | None] | None:
+    """Resolve a CLI target — an address (prefix) or a rows-file path — to
+    ``(address, record-or-None)``."""
+    p = Path(target)
+    if p.exists() and p.suffix == ".jsonl":
+        rows = []
+        for raw in p.read_text(errors="replace").splitlines():
+            if not raw.strip():
+                continue
+            try:
+                row = json.loads(raw)
+            except json.JSONDecodeError:
+                continue
+            if _classify_jsonl_line(row) in ("result_row", "perf_row"):
+                rows.append(row)
+        if not rows:
+            return None
+        idx = (line - 1) if line is not None else len(rows) - 1
+        if not (0 <= idx < len(rows)):
+            return None
+        addr = content_address(rows[idx])
+        return addr, by_addr.get(addr)
+    matches = sorted({
+        a for a in by_addr if a.startswith(target)
+    }) if len(target) >= 8 else []
+    if len(matches) == 1:
+        return matches[0], by_addr[matches[0]]
+    return None
+
+
+def _render_tree(
+    addr: str, by_addr: dict[str, dict], prefix: str = "", seen=None
+) -> list[str]:
+    seen = set() if seen is None else seen
+    rec = by_addr.get(addr)
+    if rec is None:
+        return [f"{prefix}?? {addr[:12]}… (unresolved)"]
+    label = str(rec.get("kind"))
+    bits = [f"{label} {addr[:12]}…"]
+    for field in ("point", "scenario", "runs", "run_id", "git_rev"):
+        v = rec.get(field)
+        if v is not None:
+            bits.append(f"{field}={v}")
+    if rec.get("git_dirty"):
+        bits.append("dirty")
+    lines = [prefix + "  ".join(bits)]
+    if addr in seen:
+        lines[-1] += "  (cycle)"
+        return lines
+    seen.add(addr)
+    parents = [str(p) for p in rec.get("parents") or []]
+    pad = prefix.replace("└─ ", "   ").replace("├─ ", "│  ")
+    for i, parent in enumerate(parents):
+        last = i == len(parents) - 1
+        branch = "└─ " if last else "├─ "
+        lines.extend(_render_tree(parent, by_addr, pad + branch, seen))
+    return lines
+
+
+def lineage_main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpusim lineage",
+        description="Walk one artifact's provenance chain "
+        "(row → run → checkpoint_load → checkpoint) as a terminal tree.",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_show = sub.add_parser("show", help="render an artifact's parent chain")
+    p_show.add_argument(
+        "target",
+        help="an artifact address (sha256 hex, >= 8-char prefix) or a rows "
+        ".jsonl path (defaults to its last row)",
+    )
+    p_show.add_argument(
+        "--line", type=int, default=None,
+        help="1-based row number when TARGET is a rows file",
+    )
+    p_show.add_argument(
+        "--lineage", type=Path, metavar="JSONL",
+        help="the lineage ledger (default: $TPUSIM_PROVENANCE)",
+    )
+    args = ap.parse_args(argv)
+
+    lineage_paths = _find_lineage_paths([], args.lineage)
+    records: list[dict] = []
+    for p in lineage_paths:
+        records.extend(load_lineage(p))
+    if not records:
+        print("error: no lineage records (pass --lineage or set "
+              f"{PROVENANCE_ENV})", file=sys.stderr)
+        return 2
+    by_addr: dict[str, dict] = {}
+    for rec in records:
+        for a in (rec.get("content_sha256"), rec.get("artifact_id")):
+            if isinstance(a, str):
+                by_addr.setdefault(a, rec)
+    resolved = _resolve_target(args.target, args.line, by_addr)
+    if resolved is None:
+        print(
+            f"error: cannot resolve {args.target!r} to one artifact "
+            f"(unknown/ambiguous address, or no rows in the file)",
+            file=sys.stderr,
+        )
+        return 1
+    addr, rec = resolved
+    if rec is None:
+        print(
+            f"error: row hashes to {addr[:12]}… but no lineage record "
+            f"resolves it — unrecorded or mutated artifact", file=sys.stderr,
+        )
+        return 1
+    print("\n".join(_render_tree(addr, by_addr)))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# CLI: `tpusim bundle create|verify`.
+
+_BUNDLE_MANIFEST = "manifest.json"
+_BUNDLE_SUFFIXES = (".jsonl", ".json", ".npz", ".prom", ".txt")
+
+
+def _bundle_mode(path: Path) -> str:
+    return "gz" if path.name.endswith((".tar.gz", ".tgz")) else ""
+
+
+def bundle_main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpusim bundle",
+        description="Sealed evidence bundles: a tarball of ledgers plus a "
+        "manifest of per-file sha256 hashes that `verify` re-hashes fully "
+        "offline — the portable debug/repro bundle.",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_cre = sub.add_parser("create", help="seal artifacts into a bundle")
+    p_cre.add_argument("out", type=Path, help="bundle path (.tar or .tar.gz)")
+    p_cre.add_argument(
+        "paths", nargs="+", type=Path,
+        help="artifact files/dirs to seal (ledgers, rows, checkpoints)",
+    )
+    p_ver = sub.add_parser("verify", help="re-hash a bundle offline")
+    p_ver.add_argument("bundle", type=Path)
+    args = ap.parse_args(argv)
+
+    if args.cmd == "create":
+        files: list[Path] = []
+        for p in args.paths:
+            if p.is_dir():
+                files.extend(
+                    f for f in sorted(p.rglob("*"))
+                    if f.is_file() and f.suffix in _BUNDLE_SUFFIXES
+                )
+            elif p.is_file():
+                files.append(p)
+            else:
+                print(f"error: no such path: {p}", file=sys.stderr)
+                return 2
+        if not files:
+            print("error: nothing to seal (an empty bundle is no evidence)",
+                  file=sys.stderr)
+            return 2
+        seen: set[str] = set()
+        manifest: dict[str, Any] = {"schema": SCHEMA, "files": []}
+        entries: list[tuple[str, Path]] = []
+        for f in files:
+            # Stable, collision-free member names: the relative shape is
+            # kept when possible, uniquified otherwise.
+            name = f.as_posix().lstrip("/").replace("..", "__")
+            while name in seen:
+                name = "_/" + name
+            seen.add(name)
+            entries.append((name, f))
+            manifest["files"].append({
+                "path": name,
+                "sha256": sha256_file(f),
+                "size": f.stat().st_size,
+            })
+        n_records = 0
+        for name, f in entries:
+            if f.name == "lineage.jsonl":
+                try:
+                    n_records += len(load_lineage(f, strict=True))
+                except ValueError as e:
+                    print(f"error: refusing to seal a broken lineage ledger: {e}",
+                          file=sys.stderr)
+                    return 2
+        manifest["lineage_records"] = n_records
+        manifest["created"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+        args.out.parent.mkdir(parents=True, exist_ok=True)
+        mode = "w:" + _bundle_mode(args.out)
+        with tarfile.open(args.out, mode.rstrip(":")) as tar:
+            blob = json.dumps(manifest, indent=2).encode()
+            info = tarfile.TarInfo(_BUNDLE_MANIFEST)
+            info.size = len(blob)
+            tar.addfile(info, io.BytesIO(blob))
+            for name, f in entries:
+                tar.add(f, arcname=name)
+        print(
+            f"[bundle] sealed {len(entries)} file(s), {n_records} lineage "
+            f"record(s) into {args.out}"
+        )
+        return 0
+
+    # verify
+    try:
+        with tarfile.open(args.bundle, "r:*") as tar:
+            member = tar.extractfile(_BUNDLE_MANIFEST)
+            if member is None:
+                raise ValueError(f"no {_BUNDLE_MANIFEST} member")
+            manifest = json.loads(member.read().decode())
+            listed = manifest.get("files")
+            if not isinstance(listed, list) or not listed:
+                raise ValueError("manifest lists no files")
+            bad: list[str] = []
+            for entry in listed:
+                name, want = entry.get("path"), entry.get("sha256")
+                blob = tar.extractfile(str(name))
+                if blob is None:
+                    bad.append(f"{name}: listed in manifest but missing")
+                    continue
+                h = hashlib.sha256()
+                for chunk in iter(lambda: blob.read(1 << 20), b""):
+                    h.update(chunk)
+                if h.hexdigest() != want:
+                    bad.append(
+                        f"{name}: sha256 mismatch (manifest {str(want)[:12]}…, "
+                        f"actual {h.hexdigest()[:12]}…)"
+                    )
+    except (OSError, tarfile.TarError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: not a verifiable bundle: {e}", file=sys.stderr)
+        return 2
+    if bad:
+        for line in bad:
+            print(f"error: {line}", file=sys.stderr)
+        print(f"error: bundle verification FAILED ({len(bad)} file(s))",
+              file=sys.stderr)
+        return 1
+    print(f"[bundle] verified {len(listed)} file(s): all hashes match")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(audit_main())

@@ -184,7 +184,7 @@ def _batch_aggregates(batches: list[dict]) -> dict[str, Any] | None:
     return agg
 
 
-def render_report(spans: list[dict], fmt: str = "text", slo=None) -> str:
+def render_report(spans: list[dict], fmt: str = "text", slo=None, lineage=None) -> str:
     """The dashboard string for one telemetry ledger (``fmt``: text | md).
 
     ``slo`` is an optional list of :class:`tpusim.metrics.Objective`; when
@@ -192,7 +192,12 @@ def render_report(spans: list[dict], fmt: str = "text", slo=None) -> str:
     (``tpusim.metrics.evaluate_slos``) that ``tpusim slo check`` gates on —
     one source of truth, no drifting twin renderers. The panel is
     span-scoped (objectives over perf-ledger metrics show NO-DATA here; the
-    gate's full state-dir derivation lives in ``slo check``)."""
+    gate's full state-dir derivation lives in ``slo check``).
+
+    ``lineage`` is an optional :func:`tpusim.provenance.summarize_lineage`
+    digest; when given, a provenance panel shows the lineage ledger next to
+    the spans it cross-checks (``tpusim audit`` is the gate; this is the
+    glance)."""
     md = fmt == "md"
     out: list[str] = []
 
@@ -591,6 +596,21 @@ def render_report(spans: list[dict], fmt: str = "text", slo=None) -> str:
         heading("SLO status")
         table(SLO_HEADERS, slo_rows(evaluate_slos(slo, snapshot_from_spans(spans))))
 
+    if lineage:
+        # Provenance digest (tpusim.provenance): what the lineage ledger
+        # recorded alongside these spans — the audit gate's raw material,
+        # summarized by the SAME digest `tpusim watch` renders from.
+        heading("Provenance (lineage ledger)")
+        rows = [
+            ["lineage records", str(lineage["records"])],
+            ["parent edges (DAG)", str(lineage["edges"])],
+            ["dirty-tree records", str(lineage["dirty_records"])],
+        ]
+        rows += [
+            [f"kind `{k}`", str(n)] for k, n in sorted(lineage["kinds"].items())
+        ]
+        table(["counter", "value"], rows)
+
     faults = [sp for sp in spans if sp["span"] == "chaos"]
     if faults:
         # The fault ledger: every injected fault of a chaos drill
@@ -715,6 +735,11 @@ def main(argv: list[str] | None = None) -> int:
         help="render an SLO status panel from this JSON/TOML objectives "
         "config (same evaluator as `tpusim slo check`)",
     )
+    ap.add_argument(
+        "--lineage", type=Path, metavar="JSONL",
+        help="render a provenance panel from this lineage ledger (default: "
+        "every lineage.jsonl under a directory PATH)",
+    )
     args = ap.parse_args(argv)
 
     slo = None
@@ -729,6 +754,20 @@ def main(argv: list[str] | None = None) -> int:
     if not args.path.exists():
         print(f"error: {args.path} does not exist", file=sys.stderr)
         return 2
+    # The provenance digest rides next to the span panels: an explicit
+    # --lineage ledger, or every lineage.jsonl under a state-dir PATH
+    # (tolerant load — a live writer may still be appending).
+    from .provenance import load_lineage, summarize_lineage
+
+    lineage_paths = (
+        [args.lineage] if args.lineage is not None
+        else sorted(args.path.rglob("lineage.jsonl")) if args.path.is_dir()
+        else []
+    )
+    lineage_records: list[dict] = []
+    for lp in lineage_paths:
+        lineage_records.extend(load_lineage(lp))
+    lineage = summarize_lineage(lineage_records)
     if args.path.is_dir():
         if find_trace_files(args.path):
             # XLA trace directory (--trace-dir output): op-level attribution.
@@ -750,9 +789,13 @@ def main(argv: list[str] | None = None) -> int:
                     f"telemetry ledgers", file=sys.stderr,
                 )
                 return 2
-            text = render_report(spans, fmt=args.format, slo=slo)
+            text = render_report(
+                spans, fmt=args.format, slo=slo, lineage=lineage
+            )
     else:
-        text = render_report(load_spans(args.path), fmt=args.format, slo=slo)
+        text = render_report(
+            load_spans(args.path), fmt=args.format, slo=slo, lineage=lineage
+        )
     try:
         print(text, end="", flush=True)
     except BrokenPipeError:

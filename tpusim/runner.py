@@ -33,6 +33,12 @@ from .config import SimConfig
 from .convergence import MomentAccumulator
 from .engine import Engine
 from .profiling import Profiler
+from .provenance import (
+    checkpoint_address,
+    checkpoint_content,
+    emit_lineage,
+    lineage_armed,
+)
 from .stats import SimResults
 from .telemetry import CompileLedger, TelemetryRecorder, device_memory_attrs
 
@@ -422,12 +428,41 @@ def run_simulation_config(
             if checkpoint_path else None
         )
         runs_done, sums = 0, None
+        # The lineage parent this run's record will cite when it resumed from
+        # a durable checkpoint — the address is deterministic over
+        # (fingerprint, runs_done), so it resolves the SAVING process's
+        # checkpoint record even when that process is long dead.
+        ck_parent: str | None = None
         if ckpt is not None:
             t_ld = time.perf_counter()
             loaded = ckpt.load()
             if loaded is not None:
                 runs_done, sums = loaded
                 logger.info("resuming from checkpoint at %d/%d runs", runs_done, config.runs)
+                if lineage_armed():
+                    # Load-side attestation: a SIGKILL *inside* ckpt.save can
+                    # leave the checkpoint durable but its lineage record
+                    # unwritten (the process died between the rename and the
+                    # emit). The loader just proved the save durable by
+                    # loading it, and checkpoint content is deterministic
+                    # over (fingerprint, runs_done) — so re-attest it here,
+                    # which resolves the same content address the save-side
+                    # record would have. Duplicate attestations of one save
+                    # are harmless: audit joins by content address.
+                    ck_addr = emit_lineage(
+                        "checkpoint",
+                        content=checkpoint_content(ckpt.fingerprint, runs_done),
+                        config_fingerprint=ckpt.fingerprint,
+                        runs_done=runs_done, path=str(ckpt.path),
+                        attested="load",
+                    )
+                    ck_parent = emit_lineage(
+                        "checkpoint_load",
+                        parents=(ck_addr
+                                 or checkpoint_address(ckpt.fingerprint, runs_done),),
+                        config_fingerprint=ckpt.fingerprint,
+                        runs_done=runs_done, path=str(ckpt.path),
+                    )
                 if telemetry is not None:
                     # Backdated like the batch spans: a default t_start would
                     # stamp the span's END and place the interval in the
@@ -710,6 +745,13 @@ def run_simulation_config(
                 if ckpt is not None:
                     t_ck = time.perf_counter()
                     ckpt.save(runs_done, sums)
+                    if lineage_armed():
+                        emit_lineage(
+                            "checkpoint",
+                            content=checkpoint_content(ckpt.fingerprint, runs_done),
+                            config_fingerprint=ckpt.fingerprint,
+                            runs_done=runs_done, path=str(ckpt.path),
+                        )
                     if telemetry is not None:
                         dur_ck = time.perf_counter() - t_ck
                         telemetry.emit(
@@ -775,6 +817,18 @@ def run_simulation_config(
             # self-describing (the ROADMAP's drift note, now machine-read).
             **environment_attrs(),
         )
-    return SimResults.from_sums(
+    res = SimResults.from_sums(
         sums, config, mode=config.resolved_mode, elapsed_s=elapsed, compile_s=compile_s
     )
+    if lineage_armed():
+        emit_lineage(
+            "run", content=res.to_dict(), parents=(ck_parent,),
+            config_fingerprint=(
+                ckpt.fingerprint if ckpt is not None
+                else checkpoint_fingerprint(config, eng.chunk_steps)
+            ),
+            seed=config.seed, runs=runs_done,
+            reuse_key=repr(eng.reuse_key()), backend="tpu",
+            run_id=telemetry.run_id if telemetry is not None else None,
+        )
+    return res

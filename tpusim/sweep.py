@@ -22,6 +22,13 @@ from pathlib import Path
 from typing import Callable, Iterable
 
 from .config import MinerConfig, NetworkConfig, SimConfig, default_network
+from .provenance import (
+    emit_lineage,
+    lineage_armed,
+    lineage_last,
+    lineage_note_parents,
+    lineage_take_parents,
+)
 
 #: 2025 pool hashrate distribution used across the baseline sweeps.
 _DIST_2025 = (30, 29, 12, 11, 8, 5, 3, 1, 1)
@@ -228,6 +235,19 @@ def run_sweep(
             recorder.chaos = chaos
 
     def emit_row(row: dict, runs: int) -> None:
+        if lineage_armed():
+            # The row's lineage record, content-addressed over the EXACT dict
+            # written below (json round-trips floats exactly, so the on-disk
+            # row re-hashes to the same address). Parents come from the
+            # point-keyed mailbox: run_one files the run record that produced
+            # the row; a packed resume files its checkpoint_load. Emitted
+            # even with no out_path — fleet grid workers run this path and
+            # the supervisor writes their rows verbatim.
+            emit_lineage(
+                "sweep_row", content=row,
+                parents=lineage_take_parents(row["point"]),
+                point=row["point"], runs=runs, backend=backend,
+            )
         if out_path is not None:
             # Torn-trailing-line repair before every append (a killed window
             # can cut the previous row mid-write) — the shared discipline of
@@ -267,6 +287,12 @@ def run_sweep(
                 # run_id, so one ledger correlates the whole grid.
                 kwargs["telemetry"] = recorder
             res = get_backend("tpu")(config, **kwargs)
+            if lineage_armed():
+                # File the run record the backend just emitted as this
+                # point's parent; emit_row pops the mailbox when the row
+                # lands (possibly after later points finish, under the
+                # buffered point-order flush).
+                lineage_note_parents(name, lineage_last("run"))
         else:
             res = get_backend(backend)(config)
         # Spread first: the sweep's own wall-clock (which includes checkpoint

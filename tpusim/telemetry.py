@@ -85,7 +85,7 @@ __all__ = [
 ]
 
 
-def append_jsonl_line(path: str | Path, line: str) -> None:
+def append_jsonl_line(path: str | Path, line: str, *, fsync: bool = False) -> None:
     """Append one line to an append-only JSONL file, repairing a torn trailing
     line first: a killed window (``timeout -k`` mid-write, a preempted VM) can
     leave the file's final line truncated with no newline, and appending
@@ -96,7 +96,12 @@ def append_jsonl_line(path: str | Path, line: str) -> None:
     contains non-ASCII. THE shared append discipline behind the sweep row
     writer (tpusim.sweep) and the fleet supervisor's work ledger
     (tpusim.fleet) — crash tolerance on the write side, pairing
-    :func:`load_spans`-style tolerance on the read side."""
+    :func:`load_spans`-style tolerance on the read side.
+
+    ``fsync=True`` flushes and fsyncs the append before returning: once the
+    call returns, the line survives a SIGKILL/power cut. Ledgers whose rows
+    are *evidence* rather than observability (the provenance lineage ledger,
+    the fleet work ledger) pay the sync; high-rate span streams do not."""
     path = Path(path)
     if path.exists() and path.stat().st_size > 0:
         with path.open("rb+") as bh:
@@ -105,6 +110,9 @@ def append_jsonl_line(path: str | Path, line: str) -> None:
                 bh.write(b"\n")
     with path.open("a") as fh:
         fh.write(line.rstrip("\n") + "\n")
+        if fsync:
+            fh.flush()
+            os.fsync(fh.fileno())
 
 
 def environment_attrs() -> dict[str, Any]:

@@ -41,7 +41,8 @@ def _bar(frac: float, width: int = 28) -> str:
 
 
 def render_watch(
-    spans: list[dict], source: str, now: float | None = None, slo=None
+    spans: list[dict], source: str, now: float | None = None, slo=None,
+    lineage=None,
 ) -> str:
     """One full dashboard frame for the ledger's CURRENT state. Ledgers can
     hold several runs (appended files, sweeps): panels follow the most
@@ -274,6 +275,18 @@ def render_watch(
         out.append(f"SLO status ({worst.upper()}):")
         out.extend(text_table(SLO_HEADERS, slo_rows(results)))
 
+    # --- Provenance digest (tpusim.provenance): the lineage ledger growing
+    # next to this span ledger, re-read every frame through the tolerant
+    # loader — same digest as the `tpusim report` panel.
+    if lineage:
+        kinds = ", ".join(
+            f"{k}:{n}" for k, n in sorted(lineage["kinds"].items())
+        )
+        out.append(
+            f"provenance: {lineage['records']} lineage record(s) · "
+            f"{lineage['edges']} parent edge(s) · {kinds}"
+        )
+
     # --- Fault ledger.
     faults = [sp for sp in mine if sp["span"] == "chaos"]
     if faults:
@@ -319,6 +332,11 @@ def main(argv: list[str] | None = None) -> int:
         help="re-evaluate this JSON/TOML objectives config every frame and "
         "render an SLO status panel (same evaluator as `tpusim slo check`)",
     )
+    ap.add_argument(
+        "--lineage", type=Path, metavar="JSONL",
+        help="re-read this lineage ledger every frame and render a "
+        "provenance line (default: $TPUSIM_PROVENANCE when set)",
+    )
     args = ap.parse_args(argv)
 
     slo = None
@@ -338,10 +356,21 @@ def main(argv: list[str] | None = None) -> int:
     if args.once and not args.path.exists():
         print(f"error: {args.path} does not exist", file=sys.stderr)
         return 2
+    import os
+
+    from .provenance import PROVENANCE_ENV, load_lineage, summarize_lineage
+
+    lineage_path = args.lineage
+    if lineage_path is None and os.environ.get(PROVENANCE_ENV):
+        lineage_path = Path(os.environ[PROVENANCE_ENV])
     try:
         while True:
             spans = load_spans(args.path) if args.path.exists() else []
-            frame = render_watch(spans, str(args.path), slo=slo)
+            lineage = (
+                summarize_lineage(load_lineage(lineage_path))
+                if lineage_path is not None else None
+            )
+            frame = render_watch(spans, str(args.path), slo=slo, lineage=lineage)
             if not args.once and not args.no_clear:
                 sys.stdout.write(_CLEAR)
             try:
