@@ -411,6 +411,58 @@ slo_rc=0; python -m tpusim slo check "$slo_empty" > /dev/null 2>&1 || slo_rc=$?
 rm -rf "$slo_empty"
 echo "metrics & SLO plane: exposition valid, endpoint scraped, objectives green"
 
+echo "== serve leg (crash-only service: loadgen storm, SLO profile, drain) =="
+# The crash-only simulation service end to end (tpusim.serve): a live daemon
+# on an ephemeral port, the HTTP loadgen storm (warmup compiles, then a
+# timed mixed-shape/cache-hit storm — compiles_per_query must stay 0), the
+# serve SLO profile gated over the daemon's own state dir, then the graceful
+# drain drill: a SECOND storm is TERMed mid-load and the daemon must exit 0
+# with closed accounting (accepted == served + shed, drain.json clean) —
+# never a lost accepted query. The daemon inherits TPUSIM_PROVENANCE, so
+# `tpusim audit` then resolves every served row to a served_query record.
+serve_dir="$tele_dir/serve"
+mkdir -p "$serve_dir"
+env JAX_PLATFORMS=cpu python -m tpusim serve --state-dir "$serve_dir" \
+  --port 0 > "$serve_dir/daemon.log" 2>&1 &
+serve_pid=$!
+for _ in $(seq 1 150); do
+  [ -f "$serve_dir/endpoint.json" ] && break
+  sleep 0.2
+done
+[ -f "$serve_dir/endpoint.json" ] \
+  || { echo "serve daemon never wrote endpoint.json" >&2; cat "$serve_dir/daemon.log" >&2; exit 1; }
+serve_url=$(python - "$serve_dir/endpoint.json" <<'EOF'
+import json, sys
+print(json.load(open(sys.argv[1]))["url"])
+EOF
+)
+python scripts/loadgen.py --serve "$serve_url" --queries 6 --concurrency 3 \
+  --out "$serve_dir/perf/loadgen.jsonl"
+python -m tpusim slo check "$serve_dir" --profile serve
+# Mid-load drain: storm the daemon again (fresh seed: real cache-miss work
+# in flight), TERM it mid-storm, require exit 0 + clean accounting. The
+# drain 503s the storm's unadmitted tail (that is admission control working,
+# not a failure), so the background loadgen's own exit code is not gated.
+python scripts/loadgen.py --serve "$serve_url" --queries 6 --concurrency 3 \
+  --seed 100 --quiet --out "$serve_dir/perf/loadgen2.jsonl" \
+  > /dev/null 2>&1 &
+loadgen_pid=$!
+sleep 2
+kill -TERM "$serve_pid"
+serve_rc=0; wait "$serve_pid" || serve_rc=$?
+wait "$loadgen_pid" 2>/dev/null || true
+[ "$serve_rc" -eq 0 ] \
+  || { echo "serve drain: daemon exited $serve_rc, want 0" >&2; cat "$serve_dir/daemon.log" >&2; exit 1; }
+python - "$serve_dir/drain.json" <<'EOF'
+import json, sys
+summary = json.load(open(sys.argv[1]))
+assert summary["clean"] is True, summary
+assert summary["accepted"] == summary["served"] + summary["shed"], summary
+print(f"serve drain: accepted={summary['accepted']} served={summary['served']} "
+      f"shed={summary['shed']} rejected={summary['rejected']} clean")
+EOF
+python -m tpusim audit "$serve_dir"
+
 echo "== flight-recorder trace smoke =="
 # One tiny flight-enabled run end-to-end: export the Perfetto trace + JSONL
 # event log, validate the trace schema, and cross-check the event rows

@@ -1,13 +1,22 @@
 """Load generator for the metrics/SLO plane: N concurrent quick-shape sweep
-queries through the packed path, appending per-query latency rows to the
-perf ledger.
+queries, appending per-query latency rows to the perf ledger.
 
-Each "query" is what the future serve daemon will answer: a small
-selfish-threshold grid (the ci.sh packed-leg shape) dispatched through
-``run_sweep(..., packed=True)`` against a SHARED engine cache. One untimed
-warmup query compiles the engines; the timed queries then run concurrently
-across ``--concurrency`` worker threads, so the recorded latencies include
-real dispatch contention — the number the p50/p99 SLO gate must hold.
+Two modes, one ledger shape:
+
+* **In-process** (default): each "query" is a small selfish-threshold grid
+  (the ci.sh packed-leg shape) dispatched through
+  ``run_sweep(..., packed=True)`` against a SHARED engine cache. One untimed
+  warmup query compiles the engines; the timed queries then run concurrently
+  across ``--concurrency`` worker threads, so the recorded latencies include
+  real dispatch contention — the number the p50/p99 SLO gate must hold.
+* **Service** (``--serve URL``): the same mixed-shape query stream is driven
+  as concurrent HTTP ``POST /api/query`` calls against a live ``tpusim
+  serve`` daemon — some queries repeat configs (exact cache hits), some
+  alternate pack shapes (coalescing + engine-cache reuse) — so the SLO
+  evaluator gates the REAL service path, not just the in-process proxy.
+  Retryable rejections — 503 backpressure and 504 shed — are retried with
+  backoff and still count inside the query's recorded latency. Timed-phase
+  compiles are read from the daemon's ``GET /api/stats`` counter delta.
 
 Two perf-ledger rows land per invocation (tpusim.perf schema, scenario
 ``loadgen``):
@@ -17,17 +26,20 @@ Two perf-ledger rows land per invocation (tpusim.perf schema, scenario
                      the tpusim_query_latency_seconds histogram)
   compiles_per_query value = backend compiles observed during the TIMED
                      phase / queries — the warmed path must not compile, so
-                     the default SLO pins this == 0
+                     the default AND serve SLO profiles pin this == 0
 
 Usage:
     JAX_PLATFORMS=cpu python scripts/loadgen.py --queries 4 --concurrency 2 \
         --out artifacts/perf/loadgen.jsonl
+    python scripts/loadgen.py --serve http://127.0.0.1:8700 --queries 8 \
+        --concurrency 4 --out serve/perf.jsonl
     python -m tpusim slo check artifacts/perf/
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -56,6 +68,136 @@ def query_points(seed: int, rng: str = "threefry"):
     return pts
 
 
+def serve_payloads(seed: int, queries: int, rng: str = "threefry"):
+    """The service-mode query stream: ``queries`` POST bodies cycling over
+    three distinct configs — two block intervals at batch 8 (one pack
+    shape) plus a batch-4 variant (a SECOND pack shape), so a storm
+    exercises shape grouping, and every repeat of a config is an exact
+    result-cache hit."""
+    from tpusim.config import NetworkConfig, SimConfig
+    from tpusim.sweep import _selfish_network
+
+    base = []
+    for j, (interval_s, batch) in enumerate(
+        ((300.0, 8), (600.0, 8), (300.0, 4))
+    ):
+        net = _selfish_network(30)
+        net = NetworkConfig(miners=net.miners, block_interval_s=interval_s)
+        cfg = SimConfig(network=net, runs=8, duration_ms=86_400_000,
+                        batch_size=batch, seed=seed + 1 + j, rng=rng)
+        base.append((f"sq{seed}-i{int(interval_s)}-b{batch}",
+                     json.loads(cfg.to_json())))
+    return [
+        {"name": f"{base[i % len(base)][0]}-{i}",
+         "config": base[i % len(base)][1]}
+        for i in range(queries)
+    ]
+
+
+def _http_json(url: str, payload: dict | None = None, timeout: float = 180.0):
+    """(status, decoded-JSON body) for one GET (payload None) or POST."""
+    from urllib.error import HTTPError
+    from urllib.request import Request, urlopen
+
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = Request(url, data=data,
+                  headers={"Content-Type": "application/json"} if data else {})
+    try:
+        with urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except HTTPError as e:
+        body = e.read()
+        try:
+            decoded = json.loads(body or b"{}")
+        except json.JSONDecodeError:
+            decoded = {"error": body.decode(errors="replace")}
+        return e.code, decoded
+
+
+def _serve_query(base_url: str, payload: dict, *, attempts: int = 6) -> float:
+    """POST one query, riding out every retryable rejection the daemon's
+    crash-only contract documents: 503 backpressure (sleep the advertised
+    eta_s) and 504 shed (a drilled/wedged pack whose fault is spent — the
+    retry is served). Returns the query's total wall-clock (retries
+    included — backpressure IS service latency); raises on a non-retryable
+    or exhausted query."""
+    t0 = time.perf_counter()
+    last: dict = {}
+    for _ in range(attempts):
+        status, body = _http_json(base_url + "/api/query", payload)
+        if status == 200 and body.get("status") == "served":
+            return time.perf_counter() - t0
+        last = {"http": status, **(body if isinstance(body, dict) else {})}
+        if status in (503, 504) and body.get("retryable"):
+            eta = body.get("eta_s")
+            time.sleep(min(float(eta), 5.0) if isinstance(eta, (int, float))
+                       else 0.5)
+            continue
+        break
+    raise RuntimeError(
+        f"query {payload.get('name')!r} not served: {last}"
+    )
+
+
+def _run_serve_mode(args) -> int:
+    from tpusim.perf import append_rows, perf_row
+
+    base_url = args.serve.rstrip("/")
+    payloads = serve_payloads(args.seed, args.queries)
+    distinct = {json.dumps(p["config"], sort_keys=True): p for p in payloads}
+
+    # Warmup: each DISTINCT config once, sequentially and untimed — the
+    # daemon's engine cache compiles here, so a compile counted during the
+    # timed storm is a genuine warmed-path cache miss.
+    if not args.quiet:
+        print(f"[loadgen] warmup: {len(distinct)} distinct config(s) "
+              f"against {base_url} (untimed, compiles expected)...")
+    for p in distinct.values():
+        _serve_query(base_url, p)
+
+    status, stats0 = _http_json(base_url + "/api/stats", timeout=30.0)
+    if status != 200:
+        print(f"error: GET /api/stats -> {status}", file=sys.stderr)
+        return 1
+    compiles0 = int((stats0.get("counters") or {}).get("compiles") or 0)
+
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(max_workers=args.concurrency) as pool:
+        latencies = list(pool.map(
+            lambda p: _serve_query(base_url, p), payloads
+        ))
+    wall = time.perf_counter() - t0
+
+    status, stats1 = _http_json(base_url + "/api/stats", timeout=30.0)
+    if status != 200:
+        print(f"error: GET /api/stats -> {status}", file=sys.stderr)
+        return 1
+    counters = stats1.get("counters") or {}
+    compiles = int(counters.get("compiles") or 0) - compiles0
+
+    latencies.sort()
+    shape = {"queries": args.queries, "concurrency": args.concurrency,
+             "mode": "serve"}
+    rows = [
+        perf_row("loadgen", "query_latency_s", latencies[0], unit="s",
+                 samples=latencies, shape=shape),
+        perf_row("loadgen", "compiles_per_query",
+                 compiles / args.queries, unit="count", shape=shape),
+    ]
+    append_rows(args.out, rows)
+    if not args.quiet:
+        mid = latencies[len(latencies) // 2]
+        print(f"[loadgen] {args.queries} queries x {args.concurrency} "
+              f"threads over HTTP in {wall:.2f}s wall: p50~{mid:.2f}s "
+              f"min {latencies[0]:.2f}s max {latencies[-1]:.2f}s, "
+              f"{compiles} timed-phase compile(s), daemon counters "
+              f"served={counters.get('served')} "
+              f"cache_hits={counters.get('cache_hits')} "
+              f"coalesced={counters.get('coalesced')}")
+        print(f"[loadgen] appended 2 rows to {args.out}")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     ap.add_argument("--queries", type=int, default=4, metavar="N",
@@ -67,10 +209,15 @@ def main(argv: list[str] | None = None) -> int:
                     help="perf ledger to append the two loadgen rows to")
     ap.add_argument("--seed", type=int, default=0,
                     help="base seed; query i runs with seed+1+i")
+    ap.add_argument("--serve", metavar="URL",
+                    help="drive a live `tpusim serve` daemon over HTTP "
+                    "instead of the in-process packed path")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
     if args.queries < 1 or args.concurrency < 1:
         ap.error("--queries and --concurrency must be >= 1")
+    if args.serve:
+        return _run_serve_mode(args)
 
     from tpusim.perf import append_rows, perf_row
     from tpusim.sweep import run_sweep
@@ -93,7 +240,7 @@ def main(argv: list[str] | None = None) -> int:
 
     compiles = 0
 
-    def on_compile() -> None:
+    def on_compile(_name: str, _secs: float) -> None:
         nonlocal compiles
         compiles += 1
 

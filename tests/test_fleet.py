@@ -339,6 +339,7 @@ def test_committed_drill_plans_load_and_name_known_seams():
         "pipeline.flag_fetch", "checkpoint.save", "checkpoint.load",
         "telemetry.write", "probe.attempt", "sweep.point",
         "fleet.spawn", "fleet.heartbeat",
+        "serve.accept", "serve.dispatch", "serve.cache", "serve.drain",
     }
     for p in plans:
         for fault in load_plan(p).faults:

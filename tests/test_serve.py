@@ -1,0 +1,406 @@
+"""The crash-only simulation service (tpusim.serve): served answers
+bit-equal to a direct packed sweep (rows and exact int64 moments, cache
+hits and coalesced queries included), the service chaos matrix (wedged
+dispatch sheds only its pack, queue-full 503 then recovery, ENOSPC on the
+result-cache write keeps serving, transient admission faults are
+retryable), SIGTERM-style drain accounting with zero lost accepted
+queries, the warmed mixed-shape storm compile pin, the `served_query`
+provenance chain, and the serve SLO profile. Every daemon test runs under
+the thread-leak guard — the runtime half of the JX015-JX019 gate.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import urllib.request
+from pathlib import Path
+from urllib.error import HTTPError
+
+import pytest
+
+import tpusim.provenance as provenance
+from tpusim.config import MinerConfig, NetworkConfig, SimConfig
+from tpusim.metrics import (
+    SloConfigError,
+    evaluate_slos,
+    load_objectives,
+    slo_exit_code,
+    snapshot_from_spans,
+)
+from tpusim.packed import run_grid
+from tpusim.provenance import PROVENANCE_ENV, load_lineage
+from tpusim.serve import ServeDaemon, ServeReject
+from tpusim.sweep import run_sweep
+from tpusim.testing import compile_count_guard
+
+REPO = Path(__file__).resolve().parent.parent
+
+#: Wall-clock-independent row comparison: everything but the timing fields.
+TIMING_KEYS = ("elapsed_s", "compile_s")
+
+
+def _cfg(
+    seed: int, *, batch: int = 8, interval_s: float = 600.0,
+    miners: tuple[int, ...] = (60, 40),
+) -> SimConfig:
+    net = NetworkConfig(miners=tuple(
+        MinerConfig(hashrate_pct=pct, propagation_ms=1000) for pct in miners
+    ), block_interval_s=interval_s)
+    return SimConfig(network=net, runs=8, duration_ms=3_600_000,
+                     batch_size=batch, seed=seed)
+
+
+def _strip(row: dict) -> dict:
+    return {k: v for k, v in row.items() if k not in TIMING_KEYS}
+
+
+def _ask(daemon: ServeDaemon, name: str, cfg: SimConfig, **kw):
+    q = daemon.submit(name, cfg, **kw)
+    assert q.done.wait(timeout=180), f"query {name} never resolved"
+    return q
+
+
+def _post(url: str, payload: dict, timeout: float = 180.0):
+    req = urllib.request.Request(
+        url + "/api/query", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+@contextlib.contextmanager
+def _daemon(tmp_path: Path, **kw):
+    d = ServeDaemon(tmp_path / "serve", **kw)
+    try:
+        yield d
+    finally:
+        d.drain()
+
+
+@contextlib.contextmanager
+def _armed(ledger: Path):
+    os.environ[PROVENANCE_ENV] = str(ledger)
+    provenance._WRITERS.clear()
+    try:
+        yield
+    finally:
+        os.environ.pop(PROVENANCE_ENV, None)
+        provenance._WRITERS.clear()
+
+
+# ---------------------------------------------------------------------------
+# Bit-equality: served == direct packed sweep, coalescing included.
+
+
+def test_served_rows_bit_equal_to_direct_sweep(tmp_path, thread_guard):
+    """Three HTTP queries — two distinct configs sharing one pack shape
+    plus an exact duplicate — admitted BEFORE the worker starts, so they
+    ride one coalesced batch. Every answer must be bit-equal to a direct
+    ``run_sweep(packed=True)`` of the same configs (rows minus wall-clock
+    timing) and carry the exact int64 moment state of ``run_grid``."""
+    c1, c2 = _cfg(11), _cfg(12, interval_s=300.0)
+    with _daemon(tmp_path) as daemon:
+        daemon.start_http()
+        results: dict[str, tuple] = {}
+
+        def go(name: str, cfg: SimConfig) -> None:
+            results[name] = _post(daemon.url, {
+                "name": name, "config": json.loads(cfg.to_json()),
+            })
+
+        threads = [
+            threading.Thread(target=go, args=(n, c))
+            for n, c in (("p1", c1), ("p2", c2), ("p1-again", c1))
+        ]
+        for t in threads:
+            t.start()
+        # All three must be queued before dispatch begins, or coalescing
+        # would depend on HTTP timing.
+        for _ in range(200):
+            if daemon.stats_snapshot()["queue_depth"] == 3:
+                break
+            threading.Event().wait(0.05)
+        assert daemon.stats_snapshot()["queue_depth"] == 3
+        daemon.start_worker()
+        for t in threads:
+            t.join(timeout=180)
+        counters = daemon.stats_snapshot()["counters"]
+
+    for name, (status, body) in results.items():
+        assert status == 200 and body["status"] == "served", (name, body)
+    # The duplicate coalesced onto p1's computation and got the same row.
+    assert counters["coalesced"] >= 1
+    assert results["p1-again"][1]["row"] == results["p1"][1]["row"]
+
+    direct = run_sweep([("p1", c1), ("p2", c2)], packed=True, quiet=True)
+    by_point = {r["point"]: r for r in direct}
+    for name, point in (("p1", "p1"), ("p2", "p2"), ("p1-again", "p1")):
+        served = dict(results[name][1]["row"])
+        served["point"] = point  # the duplicate served p1's named row
+        assert _strip(served) == _strip(by_point[point])
+
+    grid = run_grid([("p1", c1), ("p2", c2)])
+    for entry, name in zip(grid, ("p1", "p2")):
+        acc = entry["moments"]
+        want = {
+            "n": int(acc.n),
+            "m1": {k: [int(x) for x in v] for k, v in acc.m1.items()},
+            "m2": {k: [int(x) for x in v] for k, v in acc.m2.items()},
+        }
+        assert results[name][1]["moments"] == want
+
+
+def test_cache_hit_bit_equal_with_provenance_chain(tmp_path, thread_guard):
+    """A repeated query is an exact result-cache hit: identical row bytes,
+    and its ``served_query`` lineage record cites the original answer as
+    parent (the provenance the audit gate resolves)."""
+    ledger = tmp_path / "lineage.jsonl"
+    cfg = _cfg(21)
+    with _armed(ledger):
+        with _daemon(tmp_path) as daemon:
+            daemon.start()
+            q1 = _ask(daemon, "c1", cfg)
+            q2 = _ask(daemon, "c1", cfg)
+    assert q1.status == q2.status == "served"
+    assert not q1.cache_hit and q2.cache_hit
+    assert q2.row == q1.row  # bit-equal, not just statistically equal
+    records = load_lineage(ledger)
+    served = [r for r in records if r.get("kind") == "served_query"]
+    assert len(served) == 2
+    fresh = next(r for r in served if not r.get("cache_hit"))
+    hit = next(r for r in served if r.get("cache_hit"))
+    assert hit["content_sha256"] == fresh["content_sha256"]
+    assert fresh["artifact_id"] in (hit.get("parents") or []) or (
+        fresh["content_sha256"] in (hit.get("parents") or [])
+    )
+    assert q2.address in (hit.get("artifact_id"), hit.get("content_sha256"))
+
+
+# ---------------------------------------------------------------------------
+# The chaos matrix.
+
+
+def test_queue_full_rejects_retryable_503_then_recovers(tmp_path, thread_guard):
+    """Admission beyond the bounded queue is a loud, retryable 503 with
+    depth and ETA — and once the worker drains the queue, the same query
+    is admitted and served (recovery, zero silent drops)."""
+    cfg = _cfg(31)
+    with _daemon(tmp_path, queue_depth=1) as daemon:
+        daemon.start_http()  # no worker yet: the queue cannot drain
+        held = daemon.submit("held", cfg)
+        status, body = _post(daemon.url, {
+            "name": "overflow", "config": json.loads(cfg.to_json()),
+        })
+        assert status == 503
+        assert body["status"] == "rejected" and body["retryable"] is True
+        assert body["queue_depth"] >= 1 and body["eta_s"] is not None
+        daemon.start_worker()
+        assert held.done.wait(timeout=180) and held.status == "served"
+        status2, body2 = _post(daemon.url, {
+            "name": "overflow", "config": json.loads(cfg.to_json()),
+        })
+        assert status2 == 200 and body2["status"] == "served"
+        counters = daemon.stats_snapshot()["counters"]
+    assert counters["rejected"] == 1
+    assert counters["served"] == 2
+
+
+def test_wedged_dispatch_sheds_only_that_pack(tmp_path, thread_guard):
+    """The committed serve-dispatch-hang drill: the FIRST packed dispatch
+    wedges past its deadline. Only the queries riding that pack shed; a
+    concurrent query in a different pack — and every later query — is
+    served. The daemon never dies with its dispatch."""
+    # A different miner count is a different pack_shape_key: "other" rides
+    # its own pack, outside the wedged dispatch's blast radius.
+    wedged_cfg, other_cfg = _cfg(41), _cfg(42, miners=(50, 30, 20))
+    with _daemon(
+        tmp_path, chaos=REPO / "drills" / "serve-dispatch-hang.json",
+    ) as daemon:
+        daemon.start_http()
+        q_wedged = daemon.submit("wedged", wedged_cfg, deadline_s=30.0)
+        q_rider = daemon.submit("rider", wedged_cfg, deadline_s=30.0)
+        q_other = daemon.submit("other", other_cfg)
+        daemon.start_worker()
+        for q in (q_wedged, q_rider, q_other):
+            assert q.done.wait(timeout=180)
+        assert q_wedged.status == "shed" and "wedged" in q_wedged.reason
+        assert q_rider.status == "shed"  # same pack, same blast radius
+        assert q_other.status == "served"  # different pack: untouched
+        # The drill's count is spent: the same shape now serves fine.
+        q_retry = _ask(daemon, "retry", wedged_cfg)
+        assert q_retry.status == "served"
+        counters = daemon.stats_snapshot()["counters"]
+    assert counters["shed"] == 2 and counters["served"] == 2
+
+
+def test_cache_write_enospc_keeps_serving(tmp_path, thread_guard):
+    """The committed serve-cache-enospc drill: a full disk at the served-row
+    append disables persistence with one warning; the answer — and every
+    later answer — is still served from memory."""
+    with _daemon(
+        tmp_path, chaos=REPO / "drills" / "serve-cache-enospc.json",
+    ) as daemon:
+        daemon.start_worker()
+        q1 = _ask(daemon, "e1", _cfg(51))
+        q2 = _ask(daemon, "e2", _cfg(52))
+        snap = daemon.stats_snapshot()
+        rows_path = daemon.state_dir / "rows.jsonl"
+    assert q1.status == q2.status == "served"
+    assert snap["counters"]["cache_write_failures"] == 1
+    assert snap["rows_persisted"] is False
+    assert not rows_path.exists()
+
+
+def test_accept_transient_is_retryable_then_served(tmp_path, thread_guard):
+    """The committed serve-accept-transient drill: one admission fault is a
+    retryable rejection; the retry is admitted and served."""
+    cfg = _cfg(61)
+    with _daemon(
+        tmp_path, chaos=REPO / "drills" / "serve-accept-transient.json",
+    ) as daemon:
+        daemon.start_worker()
+        with pytest.raises(ServeReject) as exc:
+            daemon.submit("t1", cfg)
+        assert exc.value.retryable
+        q = _ask(daemon, "t1", cfg)
+        assert q.status == "served"
+        counters = daemon.stats_snapshot()["counters"]
+    assert counters["rejected"] == 1 and counters["served"] == 1
+
+
+def test_deadline_expired_in_queue_is_shed_not_lost(tmp_path, thread_guard):
+    """A query whose deadline passes while still queued is explicitly shed
+    (loud), never silently dropped — and never dispatched."""
+    with _daemon(tmp_path) as daemon:
+        q = daemon.submit("late", _cfg(71), deadline_s=0.05)
+        threading.Event().wait(0.2)  # let the deadline lapse pre-worker
+        daemon.start_worker()
+        assert q.done.wait(timeout=60)
+        assert q.status == "shed" and "deadline" in q.reason
+
+
+# ---------------------------------------------------------------------------
+# Drain accounting.
+
+
+def test_drain_accounts_for_every_accepted_query(tmp_path, thread_guard):
+    """Graceful drain (what the SIGTERM handler triggers): admission stops
+    (retryable rejection), the backlog finishes, and the accounting closes
+    exactly — accepted == served + shed, written to drain.json."""
+    cfgs = [_cfg(81), _cfg(82), _cfg(81, interval_s=300.0)]
+    daemon = ServeDaemon(tmp_path / "serve")
+    daemon.start()
+    queries = [daemon.submit(f"d{i}", c) for i, c in enumerate(cfgs)]
+    summary = daemon.drain()
+    assert summary["clean"] is True
+    assert summary["accepted"] == 3
+    assert summary["accepted"] == summary["served"] + summary["shed"]
+    for q in queries:
+        assert q.done.is_set() and q.status in ("served", "shed")
+    on_disk = json.loads((tmp_path / "serve" / "drain.json").read_text())
+    assert on_disk == summary
+    with pytest.raises(ServeReject):
+        daemon.submit("post-drain", cfgs[0])
+
+
+# ---------------------------------------------------------------------------
+# The compile pin: a warmed mixed-shape storm compiles nothing.
+
+
+def test_warmed_mixed_shape_storm_compiles_nothing(tmp_path, thread_guard):
+    """After one warmup query per pack shape, a mixed-shape storm of fresh
+    seeds (cache misses, both shapes interleaved) must stay at ZERO
+    compiles — the engine cache, keyed by ``Engine.reuse_key``, is doing
+    the serving."""
+    with _daemon(tmp_path) as daemon:
+        daemon.start_worker()
+        _ask(daemon, "warm-8", _cfg(91, batch=8))
+        _ask(daemon, "warm-4", _cfg(92, batch=4))
+        with compile_count_guard(exact=0):
+            for i in range(4):
+                q = _ask(daemon, f"storm-{i}",
+                         _cfg(100 + i, batch=8 if i % 2 == 0 else 4))
+                assert q.status == "served" and not q.cache_hit
+
+
+# ---------------------------------------------------------------------------
+# Budgeted queries ride run_grid_adaptive.
+
+
+def test_budgeted_query_converges_under_ci_target(tmp_path, thread_guard):
+    with _daemon(tmp_path) as daemon:
+        daemon.start_worker()
+        q = _ask(daemon, "b1", _cfg(111), ci_target_stat="blocks_found",
+                 ci_target_rel=0.5)
+        assert q.status == "served"
+        assert q.extra.get("converged") is True
+        assert q.extra.get("rounds", 0) >= 1
+        assert q.moments["n"] <= _cfg(111).runs
+
+
+# ---------------------------------------------------------------------------
+# The serve SLO profile + metrics derivation (jax-free).
+
+
+def test_serve_slo_profile_partitions_the_gate():
+    all_objs = load_objectives(root=REPO)
+    serve_objs = load_objectives(root=REPO, profile="serve")
+    default_objs = load_objectives(root=REPO, profile="default")
+    assert {o.name for o in serve_objs} == {
+        "serve-latency-p99", "serve-queue-depth-p99", "serve-shed-ratio",
+        "serve-warmed-compiles",
+    }
+    assert len(default_objs) + len(serve_objs) == len(all_objs)
+    assert all(o.profile == "default" for o in default_objs)
+    with pytest.raises(SloConfigError):
+        load_objectives(root=REPO, profile="no-such-profile")
+
+
+def test_serve_spans_feed_the_serve_metrics():
+    spans = [
+        {"span": "serve_accept", "dur_s": 0.0, "attrs": {"depth": 3}},
+        {"span": "serve_accept", "dur_s": 0.0, "attrs": {"depth": 1}},
+        {"span": "serve_query", "dur_s": 1.5,
+         "attrs": {"status": "served", "point": "a"}},
+        {"span": "serve_query", "dur_s": 9.0,
+         "attrs": {"status": "shed", "reason": "deadline"}},
+        {"span": "serve_reject", "dur_s": 0.0, "attrs": {"depth": 5}},
+        {"span": "serve_query", "dur_s": 0.1, "attrs": {}},  # torn: tolerated
+    ]
+    snap = snapshot_from_spans(spans, now=0.0)
+    lat = snap.merged_hist("tpusim_serve_latency_seconds")
+    assert lat.count == 1  # only served queries measure latency
+    depth = snap.merged_hist("tpusim_serve_queue_depth")
+    assert depth.count == 2
+    by_status = {
+        dict(k).get("status"): v
+        for k, v in snap.counters["tpusim_serve_queries"].items()
+    }
+    assert by_status == {"served": 1.0, "shed": 1.0, "rejected": 1.0,
+                         "unknown": 1.0}
+    # The shed ratio counts resolved queries only: rejections are admission
+    # control doing its job, torn spans contribute nothing.
+    assert snap.gauges["tpusim_serve_shed_ratio"][()] == 0.5
+
+
+def test_serve_profile_gates_green_on_a_healthy_snapshot():
+    """The committed serve objectives pass a healthy synthetic snapshot —
+    the same evaluation ``tpusim slo check --profile serve`` runs in the
+    ci.sh serve leg."""
+    spans = [
+        {"span": "serve_accept", "dur_s": 0.0, "attrs": {"depth": 1}},
+        {"span": "serve_query", "dur_s": 2.0,
+         "attrs": {"status": "served", "point": "a"}},
+    ]
+    perf = [{"scenario": "loadgen", "metric": "compiles_per_query",
+             "value": 0.0}]
+    snap = snapshot_from_spans(spans, perf_rows=perf, now=0.0)
+    results = evaluate_slos(load_objectives(root=REPO, profile="serve"), snap)
+    assert slo_exit_code(results) == 0, results

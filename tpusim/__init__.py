@@ -46,4 +46,4 @@ __all__ = [
     "DEFAULT_DURATION_MS",
 ]
 
-__version__ = "0.12.0"
+__version__ = "0.13.0"

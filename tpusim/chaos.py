@@ -61,6 +61,20 @@ Injection points wired through the repo (the plan's ``point`` vocabulary):
                         SUPERVISOR's per-poll heartbeat read (target, worker,
                         attempt — "hang" makes the lease read as already
                         expired, the deterministic-time expiry drill)
+  serve.accept          ServeDaemon.submit admission, before the queue;
+                        target (the query name — "transient" = a
+                        retryable-503 admission fault, "enospc" = admission
+                        I/O fault)
+  serve.dispatch        ServeDaemon._dispatch_group inside the watchdogged
+                        dispatch thunk; points, queries, adaptive ("hang"
+                        wedges ONE pack past its deadline — only that
+                        pack's queries shed, the daemon stays live)
+  serve.cache           ServeDaemon._persist_row before the served-row
+                        append; target (the point name — "enospc" =
+                        full-disk result cache: persistence disables,
+                        serving continues)
+  serve.drain           ServeDaemon.drain entry; depth (a fault here must
+                        not stop the drain — crash-only shutdown completes)
   ====================  =====================================================
 
 This table's checkable mirror is the README "Fault injection" seam table:

@@ -22,6 +22,8 @@ Examples:
     python -m tpusim metrics export fleet/ --out artifacts/metrics/fleet.prom
     python -m tpusim metrics serve --state-dir fleet/ --port 9109
     python -m tpusim slo check fleet/
+    python -m tpusim serve --state-dir serve/ --port 8700
+    python -m tpusim slo check serve/ --profile serve
     python -m tpusim audit fleet/ --lineage artifacts/provenance/lineage.jsonl
     python -m tpusim lineage show rows.jsonl --lineage artifacts/provenance/lineage.jsonl
     python -m tpusim bundle create evidence.tar rows.jsonl artifacts/provenance/
@@ -257,6 +259,14 @@ def main(argv: list[str] | None = None) -> int:
         from .metrics import slo_main
 
         return slo_main(argv[1:])
+    if argv and argv[0] == "serve":
+        # Same dispatch rule. The service front half is jax-free by design
+        # (stdlib ThreadingHTTPServer) — the daemon binds its port and
+        # answers /healthz instantly; only its dispatch worker thread pulls
+        # the engine stack on the first query (tpusim.serve).
+        from .serve import main as serve_main
+
+        return serve_main(argv[1:])
     if argv and argv[0] == "fleet":
         # Same dispatch rule. The supervisor is jax-free by design — only
         # its subprocess workers initialize a backend, so a wedged device

@@ -121,6 +121,15 @@ METRICS = (
      "age of each fleet worker's newest heartbeat, by worker"),
     ("tpusim_stat_rel_halfwidth", "gauge",
      "per-statistic 95% CI relative half-width (newest stats span)"),
+    ("tpusim_serve_latency_seconds", "histogram",
+     "accept-to-answer latency of served queries (serve_query spans)"),
+    ("tpusim_serve_queue_depth", "histogram",
+     "request-queue depth sampled at each admission (serve_accept spans)"),
+    ("tpusim_serve_queries", "counter",
+     "service queries by status=served|shed|rejected "
+     "(serve_query/serve_reject spans)"),
+    ("tpusim_serve_shed_ratio", "gauge",
+     "shed fraction of resolved service queries, shed/(served+shed)"),
 )
 
 _TYPES = {name: kind for name, kind, _ in METRICS}
@@ -268,6 +277,7 @@ def snapshot_from_spans(
     snap.counter_add("tpusim_spans", len(spans))
 
     last_stats: dict | None = None
+    serve_outcomes: dict[str, int] = {}
     for sp in spans:
         name = sp.get("span")
         dur = float(sp.get("dur_s") or 0.0)
@@ -292,6 +302,18 @@ def snapshot_from_spans(
             snap.counter_add("tpusim_fleet_requeues", 1)
         elif name == "fleet_quarantine":
             snap.counter_add("tpusim_fleet_quarantines", 1)
+        elif name == "serve_accept":
+            depth = attrs.get("depth")
+            if isinstance(depth, (int, float)) and not isinstance(depth, bool):
+                snap.observe("tpusim_serve_queue_depth", float(depth))
+        elif name == "serve_query":
+            status = str(attrs.get("status") or "unknown")
+            snap.counter_add("tpusim_serve_queries", 1, {"status": status})
+            serve_outcomes[status] = serve_outcomes.get(status, 0) + 1
+            if status == "served":
+                snap.observe("tpusim_serve_latency_seconds", dur)
+        elif name == "serve_reject":
+            snap.counter_add("tpusim_serve_queries", 1, {"status": "rejected"})
         elif name == "stats":
             last_stats = attrs
 
@@ -305,6 +327,15 @@ def snapshot_from_spans(
                 snap.gauge_set(
                     "tpusim_stat_rel_halfwidth", float(rel), {"stat": str(stat)}
                 )
+
+    # Service shed ratio: shed over resolved (served + shed). Rejections are
+    # admission control doing its job, so they count in tpusim_serve_queries
+    # but not against the shed ceiling.
+    resolved = serve_outcomes.get("served", 0) + serve_outcomes.get("shed", 0)
+    if resolved:
+        snap.gauge_set(
+            "tpusim_serve_shed_ratio", serve_outcomes.get("shed", 0) / resolved
+        )
 
     # Fleet summary -> requeue rate (the same shared extraction both
     # dashboards render from, so the gauge cannot drift from the panels).
@@ -603,6 +634,11 @@ class Objective:
     stat: str = "value"
     name: str = ""
     labels: Labels = ()
+    #: Gate grouping: ``slo check --profile X`` evaluates only profile-X
+    #: objectives, so the serve gate and the batch/fleet gate each stay a
+    #: live gate over state dirs that only ever contain their own spans
+    #: (a serve-less fleet dir must not turn the whole check into no-data).
+    profile: str = "default"
 
     def describe(self) -> str:
         return self.name or f"{self.metric}.{self.stat}{self.op}{self.threshold:g}"
@@ -626,20 +662,28 @@ def _objective_from_dict(row: Any, source: str) -> Objective:
     labels = row.get("labels") or {}
     if not isinstance(labels, dict):
         raise SloConfigError(f"{source}: objective labels must be an object")
+    profile = row.get("profile", "default")
+    if not isinstance(profile, str) or not profile:
+        raise SloConfigError(f"{source}: objective profile must be a "
+                             f"non-empty string")
     return Objective(
         metric=metric, op=op, threshold=float(threshold), stat=stat,
         name=str(row.get("name", "")), labels=_labels_key(labels),
+        profile=profile,
     )
 
 
 def load_objectives(
-    config_path: str | Path | None = None, root: str | Path | None = None
+    config_path: str | Path | None = None, root: str | Path | None = None,
+    profile: str | None = None,
 ) -> list[Objective]:
     """Objectives from an explicit JSON/TOML file, or from the repo's
     committed ``[tool.tpusim-slo]`` pyproject block (``objectives`` array of
-    tables). Raises :class:`SloConfigError` on anything structural —
-    missing file, no parser, empty/zero objectives — because a gate with no
-    objectives is a dead gate (exit 2), not a vacuous pass."""
+    tables). ``profile`` narrows to one gate's objectives (None = all — the
+    dashboards' view). Raises :class:`SloConfigError` on anything structural
+    — missing file, no parser, empty/zero objectives, a profile filter that
+    matches nothing — because a gate with no objectives is a dead gate
+    (exit 2), not a vacuous pass."""
     if config_path is None:
         pyproject = Path(root) / "pyproject.toml" if root is not None else (
             Path(__file__).resolve().parents[1] / "pyproject.toml"
@@ -673,7 +717,16 @@ def load_objectives(
             f"{p}: no SLO objectives found (need a non-empty 'objectives' "
             f"array) — an objective-less gate is a dead gate"
         )
-    return [_objective_from_dict(row, str(p)) for row in rows]
+    objectives = [_objective_from_dict(row, str(p)) for row in rows]
+    if profile is not None:
+        known = sorted({o.profile for o in objectives})
+        objectives = [o for o in objectives if o.profile == profile]
+        if not objectives:
+            raise SloConfigError(
+                f"{p}: no objectives in profile {profile!r} (profiles "
+                f"declared: {known}) — an objective-less gate is a dead gate"
+            )
+    return objectives
 
 
 def _observed(obj: Objective, snap: MetricsSnapshot) -> tuple[float | None, str]:
@@ -1013,10 +1066,15 @@ def slo_main(argv: list[str] | None = None) -> int:
         help="JSON (.json) or TOML objectives file (default: the repo "
         "pyproject's [tool.tpusim-slo] block)",
     )
+    p_chk.add_argument(
+        "--profile", default="default", metavar="NAME",
+        help="objective profile to gate on (objectives declare `profile`; "
+        "unmarked ones are profile 'default', the serve gate is 'serve')",
+    )
     args = ap.parse_args(argv)
 
     try:
-        objectives = load_objectives(args.config)
+        objectives = load_objectives(args.config, profile=args.profile)
     except SloConfigError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2

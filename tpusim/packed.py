@@ -898,6 +898,7 @@ def run_grid_adaptive(
     engine: str = "auto",
     engine_cache: dict | None = None,
     telemetry=None,
+    chaos=None,
     quiet: bool = True,
 ) -> list[dict[str, Any]]:
     """Run-until-confident over a packed grid: the ``ci_target_stat``
@@ -940,6 +941,7 @@ def run_grid_adaptive(
     eng = _make_packed_engine(
         members, engine=engine, engine_cache=engine_cache, pack_width=lanes,
     )
+    eng.chaos = chaos  # run_grid parity: engine-level seams fire under drills
     width = _pad_width(lanes, eng)
     # Per-CALL params cache: adaptive rounds produce a fresh (config, count)
     # layout almost every round, so caching them in the session-lived

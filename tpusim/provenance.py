@@ -116,6 +116,8 @@ KINDS = (
     ("checkpoint", "a durable checkpoint save (content: fingerprint + runs_done)"),
     ("checkpoint_load", "a checkpoint resume, citing the checkpoint it loaded"),
     ("flight_export", "an exported flight/trace artifact (content: the file sha256)"),
+    ("served_query", "one `tpusim serve` answer (content: the served row; "
+     "cache hits cite the original answer as parent)"),
 )
 
 #: The cross-plane invariants ``tpusim audit`` verifies: ``(name, help)``

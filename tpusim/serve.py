@@ -1,0 +1,839 @@
+"""``tpusim serve`` — the crash-only simulation service.
+
+A long-lived daemon that answers simulation queries over HTTP. The front
+half is jax-free: a stdlib ``ThreadingHTTPServer`` (the
+``metrics.serve_metrics`` discipline — tolerant handlers, no framework)
+doing admission control against a **bounded** request queue. The back half
+is a single engine-owning dispatch worker thread that drains the queue,
+groups heterogeneous queries by ``packed.pack_shape_key`` and dispatches
+each group as ONE packed ``run_grid`` batch against the session-lived
+engine cache (``Engine.reuse_key``) — so a warmed mixed-shape storm
+compiles nothing and queries coalesced into a shared pack each pay the
+pack-amortized latency, not the sum.
+
+Crash-only design, enforced seam by seam:
+
+* **Admission rejects loud.** A full queue (or a draining daemon) returns
+  a retryable 503 carrying the current depth and an ETA estimate — never
+  silent buffering. ``serve.accept`` is the chaos seam.
+* **Deadlines shed, the daemon lives.** Every query carries a wall-clock
+  deadline; dispatches run under :func:`tpusim.chaos.fetch_with_deadline`
+  (the fleet's wall-clock-watchdog discipline), so ONE wedged dispatch
+  sheds exactly the queries riding that pack — concurrent packs keep
+  answering. ``serve.dispatch`` is the seam; an
+  :class:`~tpusim.chaos.InjectedHang` there is treated exactly as a
+  watchdog expiry.
+* **Results are cached and provenance-chained.** Answers are cached by
+  (config sampling fingerprint, seed, runs, budget); a hit serves the
+  cached row BIT-EQUAL and its lineage record cites the original answer
+  as parent (``served_query`` kind). Served rows append to
+  ``<state-dir>/rows.jsonl`` in the exact ``run_sweep`` row shape, so
+  ``tpusim audit`` resolves every served answer. ``serve.cache`` is the
+  seam: ENOSPC on the row write disables persistence and the daemon keeps
+  serving from memory.
+* **SIGTERM drains gracefully.** Stop accepting (503), finish or
+  explicitly shed every accepted query, flush the result rows, the
+  telemetry ledger and the lineage ledger, write a ``drain.json``
+  accounting summary, exit 0. ``serve.drain`` is the seam.
+
+Budgets: a query may pass ``ci_target_stat``/``ci_target_rel`` instead of
+trusting its fixed ``runs`` — the group then dispatches through
+``run_grid_adaptive`` ("answer to 1% CI or deadline, whichever first":
+convergence stops early, the watchdog deadline sheds late).
+
+Every query streams progress: the daemon's recorder adopts
+``TPUSIM_TRACE_CONTEXT`` like any fleet worker, so ``serve_accept`` /
+``serve_progress`` / ``serve_query`` spans land in the caller's trace and
+``tpusim metrics``/``slo`` derive the service SLOs (profile ``serve``)
+from the same state dir.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import queue
+import signal
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Any
+
+from .chaos import ChaosError, ChaosPermanentError, as_injector
+from .config import SimConfig
+from .provenance import emit_lineage, lineage_armed
+
+logger = logging.getLogger("tpusim.serve")
+
+__all__ = ["ServeDaemon", "ServeReject", "main"]
+
+#: Default bounded request-queue depth (admission control rejects beyond it).
+DEFAULT_QUEUE_DEPTH = 64
+
+#: Default per-query wall-clock deadline (seconds).
+DEFAULT_DEADLINE_S = 120.0
+
+#: Fallback per-dispatch seconds used for queue-ETA estimates before the
+#: first dispatch has been measured.
+_ETA_SEED_S = 2.0
+
+#: Extra handler-side wait beyond a query's deadline before the handler
+#: gives up on the worker (the worker always resolves queries; this cap
+#: only bounds the HTTP thread if the daemon is torn down mid-request).
+_HANDLER_GRACE_S = 30.0
+
+
+class ServeReject(RuntimeError):
+    """An admission rejection: loud, structured, usually retryable."""
+
+    def __init__(
+        self, reason: str, *, retryable: bool = True,
+        depth: int = 0, eta_s: float | None = None,
+    ):
+        super().__init__(reason)
+        self.reason = reason
+        self.retryable = retryable
+        self.depth = depth
+        self.eta_s = eta_s
+
+
+class _Query:
+    """One accepted query riding the queue. Cross-thread handoff happens
+    through ``done`` (a per-query Event): the worker writes the result
+    fields then sets it; the HTTP handler waits on it (timed) and reads.
+    """
+
+    __slots__ = (
+        "name", "config", "ci_target_stat", "ci_target_rel", "deadline_s",
+        "t0_wall", "t0_mono", "deadline_mono", "done", "row", "moments",
+        "extra", "address", "status", "reason", "cache_hit",
+        "depth_at_accept", "cache_key", "group_key",
+    )
+
+    def __init__(
+        self, name: str, config: SimConfig, *,
+        ci_target_stat: str | None, ci_target_rel: float | None,
+        deadline_s: float,
+    ):
+        self.name = name
+        self.config = config
+        self.ci_target_stat = ci_target_stat
+        self.ci_target_rel = ci_target_rel
+        self.deadline_s = float(deadline_s)
+        self.t0_wall = time.time()
+        self.t0_mono = time.monotonic()
+        self.deadline_mono = self.t0_mono + self.deadline_s
+        self.done = threading.Event()
+        self.row: dict[str, Any] | None = None
+        self.moments: dict[str, Any] | None = None
+        self.extra: dict[str, Any] = {}
+        self.address: str | None = None
+        self.status: str | None = None
+        self.reason: str | None = None
+        self.cache_hit = False
+        self.depth_at_accept = 0
+        self.cache_key: tuple | None = None
+        self.group_key: tuple | None = None
+
+
+def _moments_payload(acc) -> dict[str, Any] | None:
+    """A MomentAccumulator's exact int64 state as JSON-exact Python ints —
+    the bit-equality surface clients (and tests) compare against a direct
+    ``run_grid`` of the same configs."""
+    if acc is None:
+        return None
+    return {
+        "n": int(acc.n),
+        "m1": {k: [int(x) for x in v] for k, v in acc.m1.items()},
+        "m2": {k: [int(x) for x in v] for k, v in acc.m2.items()},
+    }
+
+
+class ServeDaemon:
+    """The daemon: bounded queue in front, one dispatch worker behind.
+
+    Threads (both non-daemon, both joined by :meth:`drain`): the HTTP
+    accept loop and the dispatch worker. All daemon-shared mutable state
+    (counters, ETA estimate, persistence flag) is guarded by ``_lock`` on
+    BOTH sides — the JX015 contract the lint gate enforces. Per-query state
+    is handed off through each query's own Event instead of shared
+    attributes, so the queue is the only cross-thread channel.
+    """
+
+    def __init__(
+        self,
+        state_dir: str | Path,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        queue_depth: int = DEFAULT_QUEUE_DEPTH,
+        deadline_s: float = DEFAULT_DEADLINE_S,
+        engine: str = "auto",
+        chaos=None,
+    ):
+        self.state_dir = Path(state_dir)
+        self.host = host
+        self.port = port
+        self.default_deadline_s = float(deadline_s)
+        self.engine = engine
+        self._chaos = as_injector(chaos)
+        self._lock = threading.Lock()
+        self._queue: queue.Queue[_Query] = queue.Queue(maxsize=queue_depth)
+        self._stop = threading.Event()
+        self._draining = False
+        self._counters = {
+            "accepted": 0, "served": 0, "shed": 0, "rejected": 0,
+            "cache_hits": 0, "coalesced": 0, "compiles": 0,
+            "cache_write_failures": 0,
+        }
+        self._accepted: list[_Query] = []
+        self._avg_dispatch_s: float | None = None
+        self._rows_disabled = False
+        self._results: dict[tuple, dict[str, Any]] = {}  # worker-owned
+        self._engine_cache: dict = {}  # worker-owned
+        self._recorder = None
+        self._server = None
+        self._http_thread: threading.Thread | None = None
+        self._worker: threading.Thread | None = None
+        self._rows_path = self.state_dir / "rows.jsonl"
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.start_http()
+        self.start_worker()
+
+    def _ensure_recorder(self) -> None:
+        if self._recorder is None:
+            from .telemetry import TelemetryRecorder
+
+            self.state_dir.mkdir(parents=True, exist_ok=True)
+            self._recorder = TelemetryRecorder(
+                self.state_dir / "serve.tele.jsonl", chaos=self._chaos
+            )
+            if self._chaos is not None:
+                self._chaos.bind_telemetry(self._recorder)
+
+    def start_http(self) -> None:
+        """Bind the listener and start the accept loop. Split from
+        :meth:`start_worker` so tests can admit queries against a full
+        queue before any dispatch drains it."""
+        self._ensure_recorder()
+        self._server = self._build_server()
+        host, port = self._server.server_address[:2]
+        try:
+            (self.state_dir / "endpoint.json").write_text(
+                json.dumps({"url": f"http://{host}:{port}",
+                            "host": str(host), "port": int(port)})
+            )
+        except OSError as e:
+            logger.warning("could not write endpoint.json: %s", e)
+        self._http_thread = threading.Thread(
+            target=self._http_loop, name="tpusim-serve-http"
+        )
+        self._http_thread.start()
+
+    def _http_loop(self) -> None:
+        self._server.serve_forever(poll_interval=0.2)
+
+    def start_worker(self) -> None:
+        self._ensure_recorder()
+        self._worker = threading.Thread(
+            target=self._dispatch_loop, name="tpusim-serve-dispatch"
+        )
+        self._worker.start()
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def stop(self) -> None:
+        """Request drain (what the SIGTERM handler triggers via its Event in
+        :func:`main`; in-process callers may call :meth:`drain` directly)."""
+        self._stop.set()
+
+    def drain(self) -> dict[str, Any]:
+        """Graceful drain: stop accepting, finish (or explicitly shed)
+        every accepted query, flush every ledger, return the accounting
+        summary (also written to ``<state-dir>/drain.json``)."""
+        if self._chaos is not None:
+            try:
+                self._chaos.fire("serve.drain", depth=self._queue.qsize())
+            except (ChaosError, ChaosPermanentError, OSError) as e:
+                # A fault at the drain seam must not stop the drain: the
+                # whole point of crash-only shutdown is that it completes.
+                logger.warning("chaos at serve.drain: %s (draining anyway)", e)
+        with self._lock:
+            self._draining = True
+        self._stop.set()
+        if self._server is not None:
+            self._server.shutdown()
+        if self._http_thread is not None:
+            self._http_thread.join()
+            self._http_thread = None
+        if self._worker is not None:
+            self._worker.join()
+            self._worker = None
+        with self._lock:
+            accepted = list(self._accepted)
+        # Belt and braces: the worker resolves everything it dequeued and
+        # drains the queue before exiting, so this loop should find nothing
+        # — but an accepted query must NEVER be silently lost.
+        for q in accepted:
+            if not q.done.is_set():
+                self._resolve_shed(q, "shed at shutdown (drain)")
+        with self._lock:
+            counters = dict(self._counters)
+        summary = {
+            **counters,
+            "clean": counters["accepted"]
+            == counters["served"] + counters["shed"],
+        }
+        self._emit(
+            "serve_drain",
+            accepted=counters["accepted"], served=counters["served"],
+            shed=counters["shed"], rejected=counters["rejected"],
+        )
+        try:
+            (self.state_dir / "drain.json").write_text(json.dumps(summary))
+        except OSError as e:
+            logger.warning("could not write drain.json: %s", e)
+        if self._server is not None:
+            self._server.server_close()
+            self._server = None
+        if self._recorder is not None:
+            self._recorder.close()
+        return summary
+
+    # -- front half (jax-free) ---------------------------------------------
+
+    def _emit(self, span: str, **attrs: Any) -> None:
+        rec = self._recorder
+        if rec is not None:
+            rec.emit(span, **attrs)
+
+    def submit(
+        self,
+        name: str,
+        config: SimConfig,
+        *,
+        ci_target_stat: str | None = None,
+        ci_target_rel: float | None = None,
+        deadline_s: float | None = None,
+    ) -> _Query:
+        """Admission control: enqueue one query or raise
+        :class:`ServeReject` — loud, with depth and ETA, never silent."""
+        if self._chaos is not None:
+            try:
+                self._chaos.fire("serve.accept", target=name)
+            except ChaosError as e:
+                self._note_reject(f"transient admission fault: {e}")
+            except ChaosPermanentError as e:
+                self._note_reject(f"permanent admission fault: {e}",
+                                  retryable=False)
+            except OSError as e:
+                self._note_reject(f"admission I/O fault: {e}")
+        if ci_target_stat is not None and ci_target_rel is None:
+            ci_target_rel = 0.01
+        q = _Query(
+            name, config,
+            ci_target_stat=ci_target_stat, ci_target_rel=ci_target_rel,
+            deadline_s=self.default_deadline_s if deadline_s is None
+            else float(deadline_s),
+        )
+        reject: tuple[str, int, float | None] | None = None
+        depth = 0
+        with self._lock:
+            avg = self._avg_dispatch_s or _ETA_SEED_S
+            if self._draining:
+                reject = ("draining: not accepting new queries",
+                          self._queue.qsize(), None)
+            else:
+                try:
+                    self._queue.put_nowait(q)
+                except queue.Full:
+                    d = self._queue.qsize()
+                    reject = ("queue full", d, round(avg * (d + 1), 3))
+                else:
+                    self._counters["accepted"] += 1
+                    self._accepted.append(q)
+                    depth = self._queue.qsize()
+        if reject is not None:
+            self._note_reject(reject[0], depth=reject[1], eta_s=reject[2])
+        q.depth_at_accept = depth
+        self._emit("serve_accept", point=name, depth=depth)
+        return q
+
+    def _note_reject(
+        self, reason: str, *, retryable: bool = True, depth: int = 0,
+        eta_s: float | None = None,
+    ) -> None:
+        with self._lock:
+            self._counters["rejected"] += 1
+        self._emit("serve_reject", reason=reason, depth=depth)
+        raise ServeReject(reason, retryable=retryable, depth=depth, eta_s=eta_s)
+
+    def stats_snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            counters = dict(self._counters)
+            draining = self._draining
+            avg = self._avg_dispatch_s
+            rows_disabled = self._rows_disabled
+        return {
+            "counters": counters,
+            "accepting": not draining,
+            "queue_depth": self._queue.qsize(),
+            "avg_dispatch_s": avg,
+            "results_cached": len(self._results),
+            "rows_persisted": not rows_disabled,
+        }
+
+    def _build_server(self):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        daemon = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
+                path = self.path.split("?", 1)[0]
+                try:
+                    snap = daemon.stats_snapshot()
+                    if path == "/healthz":
+                        self._send(200, {
+                            "ok": True,
+                            "accepting": snap["accepting"],
+                            "queue_depth": snap["queue_depth"],
+                            "state_dir": str(daemon.state_dir),
+                        })
+                    elif path == "/api/stats":
+                        self._send(200, snap)
+                    else:
+                        self._send(404, {"error": "not found"})
+                except BrokenPipeError:  # client hung up mid-response
+                    pass
+                except Exception as e:  # noqa: BLE001 - a probe must never kill the server
+                    try:
+                        self._send(500, {"error": str(e)})
+                    except OSError:
+                        pass
+
+            def do_POST(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path != "/api/query":
+                        self._send(404, {"error": "not found"})
+                        return
+                    try:
+                        length = int(self.headers.get("Content-Length") or 0)
+                        body = json.loads(self.rfile.read(length) or b"{}")
+                        q = daemon._admit(body)
+                    except ServeReject as e:
+                        self._send(503, {
+                            "status": "rejected", "error": e.reason,
+                            "retryable": e.retryable,
+                            "queue_depth": e.depth, "eta_s": e.eta_s,
+                        })
+                        return
+                    except (KeyError, TypeError, ValueError) as e:
+                        self._send(400, {"status": "invalid",
+                                         "error": str(e), "retryable": False})
+                        return
+                    self._send(*daemon._await_query(q))
+                except BrokenPipeError:  # client hung up mid-response
+                    pass
+                except Exception as e:  # noqa: BLE001 - a query must never kill the server
+                    try:
+                        self._send(500, {"error": str(e)})
+                    except OSError:
+                        pass
+
+            def log_message(self, *args) -> None:  # quiet by default
+                pass
+
+        return ThreadingHTTPServer((self.host, self.port), Handler)
+
+    def _admit(self, body: dict[str, Any]) -> _Query:
+        """Parse one ``POST /api/query`` body and submit it. Raises
+        ValueError/KeyError (→ 400) on shape problems, ServeReject (→ 503)
+        on admission control."""
+        if not isinstance(body, dict):
+            raise ValueError("request body must be a JSON object")
+        cfg_dict = body.get("config")
+        if not isinstance(cfg_dict, dict):
+            raise ValueError('request needs a "config" object (SimConfig JSON)')
+        cfg_dict = dict(cfg_dict)
+        for field in ("runs", "seed"):
+            if field in body:
+                cfg_dict[field] = body[field]
+        config = SimConfig.from_json(json.dumps(cfg_dict))
+        if config.runs < 1:
+            raise ValueError("config.runs must be >= 1")
+        name = str(body.get("name") or f"q-{config.seed}-{config.runs}")
+        stat = body.get("ci_target_stat")
+        if stat is not None and not isinstance(stat, str):
+            raise ValueError("ci_target_stat must be a string statistic name")
+        rel = body.get("ci_target_rel")
+        if rel is not None:
+            rel = float(rel)
+            if rel <= 0:
+                raise ValueError("ci_target_rel must be > 0")
+        deadline = body.get("deadline_s")
+        if deadline is not None:
+            deadline = float(deadline)
+            if deadline <= 0:
+                raise ValueError("deadline_s must be > 0")
+        return self.submit(
+            name, config, ci_target_stat=stat, ci_target_rel=rel,
+            deadline_s=deadline,
+        )
+
+    def _await_query(self, q: _Query) -> tuple[int, dict[str, Any]]:
+        """Block the handler thread (timed waits only) until the worker
+        resolves ``q``, then render the response."""
+        cap = q.deadline_mono + _HANDLER_GRACE_S
+        while not q.done.is_set() and time.monotonic() < cap:
+            q.done.wait(timeout=0.25)
+        if not q.done.is_set():
+            return 500, {"status": "lost", "error":
+                         "query unresolved past deadline + grace", "point": q.name}
+        if q.status == "served":
+            payload: dict[str, Any] = {
+                "status": "served",
+                "point": q.name,
+                "cache_hit": q.cache_hit,
+                "row": q.row,
+                "moments": q.moments,
+                "address": q.address,
+                "queue_depth_at_accept": q.depth_at_accept,
+            }
+            payload.update(q.extra)
+            return 200, payload
+        return 504, {
+            "status": "shed", "error": q.reason or "shed",
+            "retryable": True, "point": q.name,
+        }
+
+    # -- back half (the one engine-owning worker thread) -------------------
+
+    def _dispatch_loop(self) -> None:
+        from .testing import subscribe_backend_compiles
+
+        def _on_compile(_name: str, _secs: float) -> None:
+            with self._lock:
+                self._counters["compiles"] += 1
+
+        unsubscribe = subscribe_backend_compiles(_on_compile)
+        try:
+            while True:
+                try:
+                    first = self._queue.get(timeout=0.2)
+                except queue.Empty:
+                    if self._stop.is_set():
+                        return
+                    continue
+                batch = [first]
+                while len(batch) < 256:
+                    try:
+                        batch.append(self._queue.get_nowait())
+                    except queue.Empty:
+                        break
+                try:
+                    self._process(batch)
+                except Exception:  # noqa: BLE001 - crash-only: one batch must never kill the daemon
+                    logger.exception("serve dispatch batch failed")
+                    for q in batch:
+                        if not q.done.is_set():
+                            self._resolve_shed(q, "internal dispatch error")
+        finally:
+            unsubscribe()
+
+    def _process(self, batch: list[_Query]) -> None:
+        from .packed import pack_shape_key
+        from .runner import checkpoint_fingerprint
+
+        now = time.monotonic()
+        live: list[_Query] = []
+        for q in batch:
+            if now >= q.deadline_mono:
+                self._resolve_shed(q, "deadline exceeded while queued")
+                continue
+            cfg = q.config
+            q.cache_key = (
+                checkpoint_fingerprint(cfg, cfg.resolved_chunk_steps),
+                cfg.seed, cfg.runs, q.ci_target_stat, q.ci_target_rel,
+            )
+            q.group_key = (
+                pack_shape_key(cfg), q.ci_target_stat, q.ci_target_rel,
+            )
+            live.append(q)
+        misses: list[_Query] = []
+        for q in live:
+            ent = self._results.get(q.cache_key)
+            if ent is not None:
+                self._resolve_served(q, ent, cache_hit=True)
+            else:
+                misses.append(q)
+        groups: dict[tuple, list[_Query]] = {}
+        for q in misses:
+            groups.setdefault(q.group_key, []).append(q)
+        for qs in groups.values():
+            self._dispatch_group(qs)
+
+    def _dispatch_group(self, qs: list[_Query]) -> None:
+        """One packed dispatch for one shape-agreement group, under the
+        wall-clock watchdog. Identical queries within the group coalesce
+        onto one computed point."""
+        from .chaos import InjectedHang, PipelineStallError, fetch_with_deadline
+
+        uniq: dict[tuple, list[_Query]] = {}
+        for q in qs:
+            uniq.setdefault(q.cache_key, []).append(q)
+        leaders = [group[0] for group in uniq.values()]
+        names: list[str] = []
+        seen: set[str] = set()
+        for q in leaders:
+            nm = q.name
+            while nm in seen:
+                nm += "~"
+            seen.add(nm)
+            names.append(nm)
+        points = [(nm, q.config) for nm, q in zip(names, leaders)]
+        adaptive = leaders[0].ci_target_stat is not None
+        t_disp = time.monotonic()
+        timeout = max(0.5, min(q.deadline_mono for q in qs) - t_disp)
+
+        def thunk():
+            if self._chaos is not None:
+                self._chaos.fire(
+                    "serve.dispatch", points=len(points), queries=len(qs),
+                    adaptive=adaptive,
+                )
+            from .packed import run_grid, run_grid_adaptive
+
+            if adaptive:
+                return run_grid_adaptive(
+                    points,
+                    ci_target_stat=leaders[0].ci_target_stat,
+                    ci_target_rel=leaders[0].ci_target_rel or 0.01,
+                    engine=self.engine, engine_cache=self._engine_cache,
+                    telemetry=self._recorder, chaos=self._chaos,
+                )
+
+            def progress(done_runs: int, total_runs: int) -> None:
+                self._emit(
+                    "serve_progress", done_runs=int(done_runs),
+                    total_runs=int(total_runs), queries=len(qs),
+                )
+
+            return run_grid(
+                points, engine=self.engine, engine_cache=self._engine_cache,
+                telemetry=self._recorder, chaos=self._chaos,
+                progress=progress,
+            )
+
+        try:
+            out = fetch_with_deadline(thunk, timeout, what="packed serve dispatch")
+        except (InjectedHang, PipelineStallError) as e:
+            # The watchdog expired (or the hang drill simulated exactly
+            # that): shed ONLY this pack's queries; the daemon stays live.
+            for q in qs:
+                self._resolve_shed(q, f"wedged dispatch: {e}")
+            return
+        except Exception as e:  # noqa: BLE001 - crash-only: shed the pack, keep serving
+            for q in qs:
+                self._resolve_shed(
+                    q, f"dispatch failed: {type(e).__name__}: {e}"
+                )
+            return
+        elapsed = time.monotonic() - t_disp
+        with self._lock:
+            prev = self._avg_dispatch_s
+            self._avg_dispatch_s = (
+                elapsed if prev is None else round(0.5 * prev + 0.5 * elapsed, 6)
+            )
+        for entry, (key, group) in zip(out, uniq.items()):
+            # EXACTLY the run_sweep packed row shape: served answers must be
+            # bit-equal to a direct sweep of the same configs.
+            row = {
+                **entry["results"].to_dict(),
+                "point": entry["name"],
+                "backend": "tpu",
+                "elapsed_s": round(entry["elapsed_s"], 3),
+            }
+            extra = {
+                k: entry[k] for k in ("converged", "rounds", "rel")
+                if k in entry
+            }
+            address = None
+            if lineage_armed():
+                address = emit_lineage(
+                    "served_query", content=row, point=row.get("point"),
+                    runs=row.get("runs"), backend="tpu", cache_hit=False,
+                )
+            self._persist_row(row)
+            ent = {
+                "row": row, "moments": _moments_payload(entry.get("moments")),
+                "address": address, "extra": extra,
+            }
+            self._results[key] = ent
+            for i, q in enumerate(group):
+                self._resolve_served(
+                    q, ent, cache_hit=i > 0, coalesced=len(group) > 1
+                )
+
+    def _persist_row(self, row: dict[str, Any]) -> None:
+        """Append one served row to the durable result cache. ENOSPC (real
+        or drilled via ``serve.cache``) disables persistence — warn once,
+        keep serving from memory; the gap fails loud in ``tpusim audit``."""
+        with self._lock:
+            disabled = self._rows_disabled
+        if disabled:
+            return
+        try:
+            if self._chaos is not None:
+                self._chaos.fire("serve.cache", target=row.get("point"))
+            from .telemetry import append_jsonl_line
+
+            append_jsonl_line(self._rows_path, json.dumps(row))
+        except OSError as e:
+            with self._lock:
+                self._rows_disabled = True
+                self._counters["cache_write_failures"] += 1
+            logger.warning(
+                "disabling served-row persistence after write failure "
+                "(%s: %s); the daemon keeps serving from memory",
+                type(e).__name__, e,
+            )
+
+    def _resolve_served(
+        self, q: _Query, ent: dict[str, Any], *, cache_hit: bool,
+        coalesced: bool = False,
+    ) -> None:
+        address = ent["address"]
+        if cache_hit and lineage_armed():
+            # The hit's own lineage record: same content (bit-equal row),
+            # parent = the answer it was served from.
+            row = ent["row"]
+            address = emit_lineage(
+                "served_query", content=row, parents=[ent["address"]],
+                point=row.get("point"), runs=row.get("runs"),
+                backend="tpu", cache_hit=True,
+            ) or address
+        q.row = ent["row"]
+        q.moments = ent["moments"]
+        q.extra = dict(ent["extra"])
+        q.address = address
+        q.cache_hit = cache_hit
+        q.status = "served"
+        with self._lock:
+            self._counters["served"] += 1
+            if cache_hit:
+                self._counters["cache_hits"] += 1
+            if coalesced:
+                self._counters["coalesced"] += 1
+        self._emit(
+            "serve_query",
+            t_start=q.t0_wall, dur_s=time.monotonic() - q.t0_mono,
+            point=q.name, status="served", cache_hit=cache_hit,
+            runs=(q.row or {}).get("runs"),
+        )
+        q.done.set()
+
+    def _resolve_shed(self, q: _Query, reason: str) -> None:
+        q.status = "shed"
+        q.reason = reason
+        with self._lock:
+            self._counters["shed"] += 1
+        self._emit(
+            "serve_query",
+            t_start=q.t0_wall, dur_s=time.monotonic() - q.t0_mono,
+            point=q.name, status="shed", cache_hit=False, reason=reason,
+        )
+        q.done.set()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpusim serve",
+        description="Crash-only simulation service: deadline-budgeted "
+        "request queue, pack-coalescing dispatch, backpressure and "
+        "graceful drain (see the module docstring for semantics).",
+    )
+    ap.add_argument(
+        "--state-dir", type=Path, required=True, metavar="DIR",
+        help="service state dir: serve.tele.jsonl spans, rows.jsonl served "
+        "rows, endpoint.json, drain.json — the dir `tpusim slo check "
+        "--profile serve` and `tpusim audit` gate",
+    )
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument(
+        "--port", type=int, default=0,
+        help="TCP port (0 = ephemeral; the bound endpoint is printed and "
+        "written to <state-dir>/endpoint.json)",
+    )
+    ap.add_argument(
+        "--queue-depth", type=int, default=DEFAULT_QUEUE_DEPTH,
+        help="bounded request-queue depth; admission beyond it is a "
+        "retryable 503 with depth + ETA (never silent buffering)",
+    )
+    ap.add_argument(
+        "--deadline-s", type=float, default=DEFAULT_DEADLINE_S,
+        help="default per-query wall-clock deadline (a request may pass "
+        "its own deadline_s); expiry sheds the query, loud",
+    )
+    ap.add_argument(
+        "--serve-engine", default="auto", metavar="ENGINE",
+        help="packed engine selector passed to run_grid (default: auto)",
+    )
+    ap.add_argument(
+        "--chaos", type=Path, metavar="PLAN",
+        help="chaos drill plan JSON (tpusim.chaos) armed over the serve "
+        "seams: serve.accept, serve.dispatch, serve.cache, serve.drain",
+    )
+    args = ap.parse_args(argv)
+
+    daemon = ServeDaemon(
+        args.state_dir, host=args.host, port=args.port,
+        queue_depth=args.queue_depth, deadline_s=args.deadline_s,
+        engine=args.serve_engine, chaos=args.chaos,
+    )
+    stop = threading.Event()
+
+    def _on_signal(signum, frame) -> None:
+        # JX019: a signal handler only sets the Event; the main loop below
+        # does the actual drain outside signal context.
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    daemon.start()
+    print(
+        f"[serve] listening on {daemon.url} (state dir {args.state_dir})",
+        flush=True,
+    )
+    while not stop.wait(0.2):
+        pass
+    print("[serve] drain requested; finishing accepted queries", flush=True)
+    summary = daemon.drain()
+    print(
+        f"[serve] drained: accepted={summary['accepted']} "
+        f"served={summary['served']} shed={summary['shed']} "
+        f"rejected={summary['rejected']} clean={summary['clean']}",
+        flush=True,
+    )
+    return 0 if summary["clean"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
